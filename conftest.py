@@ -1,0 +1,25 @@
+"""Repo-root pytest config: make the in-tree package importable and force
+tests onto a virtual 8-device CPU backend (the "fake cluster").
+
+Two subtleties:
+
+* ``XLA_FLAGS`` must be set before the JAX backend initialises — conftest
+  import time is early enough (backends are created lazily).
+* an accelerator plugin loaded via sitecustomize may have already overridden
+  the ``jax_platforms`` *config* (which beats the env var), so we set the
+  config explicitly, not just the environment.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

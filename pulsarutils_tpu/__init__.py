@@ -1,0 +1,61 @@
+"""pulsarutils_tpu — a TPU-native (JAX/XLA/Pallas) framework for searching
+dispersed impulsive radio signals (FRBs, single pulses) in filterbank data.
+
+This is a ground-up re-design of the capabilities of
+``matteobachetti/radio-pulsar-utils`` (``pulsarutils``) for TPU hardware:
+
+* the hot incoherent-dedispersion sweep (reference:
+  ``pulsarutils/dedispersion.py:174-202``) is a batched JAX gather kernel,
+  ``vmap``-ed over DM trials and ``shard_map``-ed over a device mesh instead
+  of numba ``prange`` threads;
+* the streaming 50%-overlap chunk pipeline (reference:
+  ``pulsarutils/clean.py:276-351``) runs device-resident with on-device
+  running statistics;
+* RFI excision / bandpass statistics (reference: ``pulsarutils/stats.py``,
+  ``pulsarutils/clean.py:58-133``) are pure-functional JAX ops;
+* everything is self-contained: native SIGPROC filterbank I/O, native
+  MAD / H-test / Z^2_n implementations (the reference borrowed these from
+  ``sigpyproc``, ``statsmodels`` and ``hendrics``).
+
+The NumPy implementations are first-class and keep the exact reference
+semantics; the JAX/TPU path is selected with ``backend="jax"`` on the public
+entry points.
+"""
+
+from .version import __version__
+
+from .ops.plan import (
+    DM_DELAY_CONST,
+    DM_SMEARING_CONST,
+    dedispersion_shifts,
+    dedispersion_shifts_batch,
+    delta_delay,
+    dedispersion_plan,
+    dm_broadening,
+    normalize_shifts,
+)
+from .ops.rebin import quick_chan_rebin, quick_resample
+from .ops.dedisperse import dedisperse, roll_and_sum, apply_dm_shifts_to_data
+from .ops.search import dedispersion_search
+from .models.simulate import simulate_test_data
+from .utils.table import ResultTable
+
+__all__ = [
+    "__version__",
+    "DM_DELAY_CONST",
+    "DM_SMEARING_CONST",
+    "dedispersion_shifts",
+    "dedispersion_shifts_batch",
+    "delta_delay",
+    "dedispersion_plan",
+    "dm_broadening",
+    "normalize_shifts",
+    "quick_chan_rebin",
+    "quick_resample",
+    "dedisperse",
+    "roll_and_sum",
+    "apply_dm_shifts_to_data",
+    "dedispersion_search",
+    "simulate_test_data",
+    "ResultTable",
+]

@@ -1,0 +1,69 @@
+"""Rebinning ops: block-sum down-sampling along channel and time axes.
+
+Capability-equivalents of the reference's ``quick_chan_rebin``
+(``pulsarutils/dedispersion.py:15-35``) and numba-jitted ``quick_resample``
+(``pulsarutils/dedispersion.py:38-57``).  Both are pure reshape+sum, which
+XLA lowers to a tiny fused reduction — no loops needed on any backend.
+
+Both truncate trailing elements that do not fill a whole block, exactly like
+the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def quick_chan_rebin(counts, factor, xp=np):
+    """Rebin along the **channel** (first) axis by an integer factor.
+
+    Reference: ``pulsarutils/dedispersion.py:15-35``.
+    """
+    nchan, nbin = counts.shape
+    n = int(nchan // factor)
+    return counts[: n * factor, :].reshape(n, factor, nbin).sum(axis=1)
+
+
+def quick_resample(counts, factor, xp=np):
+    """Rebin along the **time** (last) axis by an integer factor.
+
+    Returns a float array like the reference's njit loop accumulation
+    (``pulsarutils/dedispersion.py:38-57``).  Works on 1-D or 2-D input
+    (the reference requires 2-D; 1-D is accepted here for convenience and
+    treated as a single channel).
+    """
+    counts = xp.asarray(counts)
+    squeeze = counts.ndim == 1
+    if squeeze:
+        counts = counts[None, :]
+    nchan, nbin = counts.shape
+    n = int(nbin // factor)
+    out = (
+        counts[:, : n * factor]
+        .reshape(nchan, n, factor)
+        .astype(_float_dtype(counts, xp))
+        .sum(axis=2)
+    )
+    return out[0] if squeeze else out
+
+
+def block_sum_time(x, factor, xp=np):
+    """Block-sum a batch of series ``(..., T)`` along the last axis.
+
+    Generalised form of :func:`quick_resample` used by the batched S/N
+    scorer: keeps whatever leading (trial) axes exist, truncates ``T`` to a
+    multiple of ``factor``.
+    """
+    t = x.shape[-1]
+    n = t // factor
+    lead = x.shape[:-1]
+    return x[..., : n * factor].reshape(*lead, n, factor).sum(axis=-1)
+
+
+def _float_dtype(arr, xp):
+    if arr.dtype in (np.dtype("float32"),):
+        return arr.dtype
+    if xp is np:
+        return np.float64
+    # keep accumulation in f32 on accelerator backends
+    return np.float32

@@ -1,0 +1,222 @@
+"""The dedispersion search: plan -> dedisperse every trial -> boxcar S/N.
+
+Public entry point :func:`dedispersion_search` is the capability-equivalent
+of the reference's fast/slow search façade
+(``pulsarutils/dedispersion.py:205-251``) with its numba ``prange`` sweep
+(``pulsarutils/dedispersion.py:174-202``), unified:
+
+* one search implementation, optional dedispersed-plane capture (the
+  reference had a second, older copy of the slow path in
+  ``pulsarutils/clean.py:136-180`` — intentionally not reproduced);
+* ``backend="numpy"`` keeps exact reference semantics (float64, same
+  rounding, same scoring) and is the correctness/benchmark baseline;
+* ``backend="jax"`` runs the whole sweep as one jitted program: the trial
+  axis is processed in blocks via ``lax.map``, each block dedispersed by a
+  batched gather (see :mod:`..ops.dedisperse`) and scored on device.  All
+  shift/plan math is computed host-side in float64 and shipped as int32
+  gather offsets (2 MB for 512 trials x 1024 chans) so hit detection is
+  bit-identical to the NumPy path regardless of device precision.
+
+Scoring (reference ``dedispersion.py:186-201``): for each trial, subtract
+the mean, then for boxcar block-sums of width 1, 2, 4, 8 compute
+``snr = max / std`` and keep the best; also record the peak and std of the
+unbinned series.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .dedisperse import dedisperse_block_chunked_jax
+from .plan import (
+    dedispersion_plan,
+    dedispersion_shifts_batch,
+    normalize_shifts,
+)
+from .rebin import block_sum_time
+from ..utils.table import ResultTable
+
+#: boxcar widths tried by the scorer (reference ``dedispersion.py:190-191``)
+SEARCH_WINDOWS = (1, 2, 4, 8)
+
+
+def score_profiles(plane, xp=np):
+    """Score a block of dedispersed series ``(ndm, T)``.
+
+    Returns ``(maxvalues, stds, best_snrs, best_windows)`` per trial,
+    reproducing the reference's per-trial loop
+    (``pulsarutils/dedispersion.py:186-201``) in batched form.
+    """
+    plane = xp.asarray(plane)
+    x = plane - plane.mean(axis=1, keepdims=True)
+    maxvalues = x.max(axis=1)
+    stds = x.std(axis=1)
+
+    best_snrs = xp.zeros(x.shape[0], dtype=x.dtype)
+    best_windows = xp.zeros(x.shape[0], dtype=xp.int32)
+    for window in SEARCH_WINDOWS:
+        reb = block_sum_time(x, window, xp=xp)
+        snr = reb.max(axis=1) / reb.std(axis=1)
+        better = snr > best_snrs
+        best_snrs = xp.where(better, snr, best_snrs)
+        best_windows = xp.where(better, window, best_windows)
+    return maxvalues, stds, best_snrs, best_windows
+
+
+def _offsets_for(trial_dms, nchan, start_freq, bandwidth, sample_time, nsamples):
+    """Host-side float64 shift table -> int32 gather offsets in ``[0, T)``."""
+    shifts = dedispersion_shifts_batch(
+        np.asarray(trial_dms, dtype=np.float64), nchan, start_freq, bandwidth,
+        sample_time)
+    return normalize_shifts(shifts, nsamples)
+
+
+# ---------------------------------------------------------------------------
+# NumPy backend
+# ---------------------------------------------------------------------------
+
+def _search_numpy(data, trial_dms, start_freq, bandwidth, sample_time,
+                  capture_plane):
+    data = np.asarray(data, dtype=np.float64)
+    nchan, nsamples = data.shape
+    ndm = len(trial_dms)
+    offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
+                           sample_time, nsamples)
+
+    plane = np.empty((ndm, nsamples), dtype=np.float64) if capture_plane else None
+    maxvalues = np.empty(ndm)
+    stds = np.empty(ndm)
+    best_snrs = np.empty(ndm)
+    best_windows = np.empty(ndm, dtype=np.int32)
+
+    tidx = np.arange(nsamples)
+    block = 16  # score in small batches to bound the workspace
+    for lo in range(0, ndm, block):
+        hi = min(lo + block, ndm)
+        idx = (tidx[None, None, :] + offsets[lo:hi, :, None]) % nsamples
+        sub = np.take_along_axis(data[None, :, :], idx, axis=2).sum(axis=1)
+        if capture_plane:
+            plane[lo:hi] = sub
+        m, s, b, w = score_profiles(sub)
+        maxvalues[lo:hi] = m
+        stds[lo:hi] = s
+        best_snrs[lo:hi] = b
+        best_windows[lo:hi] = w
+
+    return maxvalues, stds, best_snrs, best_windows, plane
+
+
+# ---------------------------------------------------------------------------
+# JAX backend
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _jax_search_kernel(capture_plane, chan_block):
+    import jax
+    import jax.numpy as jnp
+
+    def per_block(data, offs):
+        plane = dedisperse_block_chunked_jax(data, offs, chan_block)
+        scores = score_profiles(plane, xp=jnp)
+        if capture_plane:
+            return scores + (plane,)
+        return scores
+
+    @jax.jit
+    def kernel(data, offset_blocks):
+        # data (C, T); offset_blocks (nblocks, dm_block, C) int32
+        return jax.lax.map(lambda offs: per_block(data, offs), offset_blocks)
+
+    return kernel
+
+
+def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
+                capture_plane, dm_block, chan_block, dtype):
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.float32
+    data = jnp.asarray(data, dtype=dtype)
+    nchan, nsamples = data.shape
+    ndm = len(trial_dms)
+    offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
+                           sample_time, nsamples)
+
+    if dm_block is None:
+        dm_block = max(1, min(ndm, 32))
+    npad = (-ndm) % dm_block
+    if npad:
+        offsets = np.concatenate([offsets, offsets[-1:].repeat(npad, axis=0)])
+    offset_blocks = offsets.reshape(-1, dm_block, nchan)
+
+    kernel = _jax_search_kernel(capture_plane, chan_block)
+    out = kernel(data, jnp.asarray(offset_blocks))
+    out = [np.asarray(o).reshape(-1, *o.shape[2:])[:ndm] for o in out]
+    if capture_plane:
+        maxvalues, stds, best_snrs, best_windows, plane = out
+    else:
+        maxvalues, stds, best_snrs, best_windows = out
+        plane = None
+    return maxvalues, stds, best_snrs, best_windows, plane
+
+
+# ---------------------------------------------------------------------------
+# Public façade
+# ---------------------------------------------------------------------------
+
+def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
+                        show=False, *, backend="numpy", capture_plane=None,
+                        trial_dms=None, dm_block=None, chan_block=None,
+                        dtype=None):
+    """Sweep trial DMs over ``data`` and score each dedispersed series.
+
+    Parameters mirror the reference façade
+    (``pulsarutils/dedispersion.py:205``); ``show=True`` additionally
+    returns the dedispersed plane, like the reference's slow path (but
+    computed by the same fast kernel — no duplicate implementation).
+
+    Extra keyword-only parameters select and tune the execution backend:
+
+    backend : ``"numpy"`` (reference semantics, float64, single core) or
+        ``"jax"`` (jitted batched gather kernel; TPU/CPU).
+    capture_plane : override for plane capture (defaults to ``show``).
+    trial_dms : explicit trial grid; default is the reference plan
+        (one trial per integer sample of band-crossing delay).
+    dm_block, chan_block : JAX blocking factors (memory/speed trade-off).
+    dtype : device dtype for the JAX path (default float32).
+
+    Returns
+    -------
+    :class:`~pulsarutils_tpu.utils.table.ResultTable` with columns
+    ``DM, max, std, snr, rebin`` — plus the ``(ndm, nsamples)`` plane if
+    ``show``/``capture_plane``.
+    """
+    nchan = data.shape[0]
+    if trial_dms is None:
+        trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
+                                      bandwidth, sample_time)
+    trial_dms = np.asarray(trial_dms, dtype=np.float64)
+    if capture_plane is None:
+        capture_plane = bool(show)
+
+    if backend == "numpy":
+        maxvalues, stds, best_snrs, best_windows, plane = _search_numpy(
+            data, trial_dms, start_freq, bandwidth, sample_time, capture_plane)
+    elif backend == "jax":
+        maxvalues, stds, best_snrs, best_windows, plane = _search_jax(
+            data, trial_dms, start_freq, bandwidth, sample_time, capture_plane,
+            dm_block, chan_block, dtype)
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+
+    table = ResultTable({
+        "DM": trial_dms,
+        "max": maxvalues,
+        "std": stds,
+        "snr": best_snrs,
+        "rebin": best_windows,
+    })
+    if capture_plane or show:
+        return table, plane
+    return table

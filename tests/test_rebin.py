@@ -1,0 +1,51 @@
+"""Rebinning doctest ports (reference ``dedispersion.py:17-26,41-46``)."""
+import numpy as np
+
+from pulsarutils_tpu.ops.rebin import (
+    block_sum_time,
+    quick_chan_rebin,
+    quick_resample,
+)
+
+
+def test_quick_chan_rebin_doctest():
+    counts = np.array([np.arange(0, 10), np.arange(2, 12),
+                       np.arange(1, 11), np.arange(3, 13),
+                       np.arange(1, 11), np.arange(3, 13)])
+    reb = quick_chan_rebin(counts, 2)
+    assert np.allclose(reb, [[2, 4, 6, 8, 10, 12, 14, 16, 18, 20],
+                             [4, 6, 8, 10, 12, 14, 16, 18, 20, 22],
+                             [4, 6, 8, 10, 12, 14, 16, 18, 20, 22]])
+
+
+def test_quick_chan_rebin_truncates():
+    counts = np.ones((7, 4))
+    assert quick_chan_rebin(counts, 2).shape == (3, 4)
+
+
+def test_quick_resample_doctest():
+    counts = np.array([np.arange(1, 11), np.arange(3, 13)])
+    reb = quick_resample(counts, 2)
+    assert np.allclose(reb, [[3, 7, 11, 15, 19], [7, 11, 15, 19, 23]])
+    assert reb.dtype == np.float64
+
+
+def test_quick_resample_truncates_and_1d():
+    x = np.arange(10)
+    assert np.allclose(quick_resample(x, 3), [3, 12, 21])
+
+
+def test_quick_resample_jax_matches():
+    import jax.numpy as jnp
+
+    counts = np.arange(24, dtype=np.float32).reshape(2, 12)
+    ref = quick_resample(counts, 4)
+    out = quick_resample(jnp.asarray(counts), 4, xp=jnp)
+    assert np.allclose(np.asarray(out), ref)
+
+
+def test_block_sum_time_batched():
+    x = np.arange(2 * 3 * 8, dtype=float).reshape(2, 3, 8)
+    out = block_sum_time(x, 4)
+    assert out.shape == (2, 3, 2)
+    assert np.allclose(out[..., 0], x[..., :4].sum(-1))
