@@ -35,6 +35,13 @@ from .ops.plan import (
     normalize_shifts,
 )
 from .ops.rebin import quick_chan_rebin, quick_resample
+from .ops.robust import digitize, h_test, mad, ref_mad, z_n_test
+from .ops.clean_ops import (
+    fft_zap_time,
+    get_noisier_channels,
+    measure_channel_variability,
+    renormalize_data,
+)
 from .ops.dedisperse import dedisperse, roll_and_sum, apply_dm_shifts_to_data
 from .ops.search import dedispersion_search
 from .models.simulate import simulate_test_data
@@ -52,6 +59,15 @@ __all__ = [
     "normalize_shifts",
     "quick_chan_rebin",
     "quick_resample",
+    "mad",
+    "ref_mad",
+    "h_test",
+    "z_n_test",
+    "digitize",
+    "renormalize_data",
+    "get_noisier_channels",
+    "measure_channel_variability",
+    "fft_zap_time",
     "dedisperse",
     "roll_and_sum",
     "apply_dm_shifts_to_data",

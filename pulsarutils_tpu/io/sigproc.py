@@ -1,0 +1,276 @@
+"""Native SIGPROC filterbank I/O.
+
+The reference reads filterbank files through the third-party
+``sigpyproc.Readers.FilReader`` (``pulsarutils/clean.py:18,284-294``,
+``pulsarutils/stats.py:6,37``).  This framework implements the format
+natively: a binary header of length-prefixed keyword/value records between
+``HEADER_START`` and ``HEADER_END``, followed by time-major sample frames
+of ``nifs * nchans`` values at 8/16/32 bits.
+
+Provided:
+
+* :class:`FilterbankReader` — memory-mapped reader with the
+  ``read_block(istart, nsamples) -> (nchans, n)`` access pattern the
+  pipeline drivers use, plus a sigpyproc-compatible ``header`` dict
+  (``fbottom``/``ftop``/``bandwidth``/``foff``/``nchans``/``tsamp``/
+  ``nsamples``/``tstart`` — the exact keys the reference pipeline consumes,
+  ``clean.py:284-294``).
+* :class:`FilterbankWriter` / :func:`write_filterbank` — streaming writer,
+  which also makes ``PUclean`` a real tool (the reference's
+  ``cleanup_data`` was a stub, ``clean.py:354-357``).
+
+Byte order is little-endian (SIGPROC convention on all modern hardware).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+_INT_KEYS = {
+    "machine_id", "telescope_id", "data_type", "barycentric",
+    "pulsarcentric", "nbits", "nsamples", "nchans", "nifs", "nbeams",
+    "ibeam",
+}
+_DOUBLE_KEYS = {
+    "az_start", "za_start", "src_raj", "src_dej", "tstart", "tsamp",
+    "fch1", "foff", "refdm", "period",
+}
+_STR_KEYS = {"source_name", "rawdatafile"}
+
+_DTYPES = {8: np.uint8, 16: np.uint16, 32: np.float32}
+
+
+def _pack_string(s):
+    b = s.encode("ascii")
+    return struct.pack("<i", len(b)) + b
+
+
+def _pack_record(key, value):
+    rec = _pack_string(key)
+    if key in _INT_KEYS:
+        rec += struct.pack("<i", int(value))
+    elif key in _DOUBLE_KEYS:
+        rec += struct.pack("<d", float(value))
+    elif key in _STR_KEYS:
+        rec += _pack_string(str(value))
+    else:
+        raise KeyError(f"unknown SIGPROC header key {key!r}")
+    return rec
+
+
+def read_header(path):
+    """Parse a SIGPROC header.  Returns ``(header_dict, data_offset)``."""
+    header = {}
+    with open(path, "rb") as f:
+        def read_string():
+            (n,) = struct.unpack("<i", f.read(4))
+            if not 0 < n < 128:
+                raise ValueError(f"corrupt SIGPROC header string length {n}")
+            return f.read(n).decode("ascii")
+
+        if read_string() != "HEADER_START":
+            raise ValueError(f"{path}: not a SIGPROC filterbank file")
+        while True:
+            key = read_string()
+            if key == "HEADER_END":
+                break
+            if key in _INT_KEYS:
+                (header[key],) = struct.unpack("<i", f.read(4))
+            elif key in _DOUBLE_KEYS:
+                (header[key],) = struct.unpack("<d", f.read(8))
+            elif key in _STR_KEYS:
+                header[key] = read_string()
+            else:
+                raise ValueError(f"{path}: unknown header key {key!r}")
+        return header, f.tell()
+
+
+def derived_header(header, data_size_bytes):
+    """Add the derived fields the pipeline consumes (band edges, size).
+
+    Channel ``i`` has centre frequency ``fch1 + i * foff``; band edges
+    extend half a channel beyond the extreme centres.  ``foff < 0``
+    (descending band) is the common convention; both signs are handled.
+    """
+    h = dict(header)
+    nchans = h["nchans"]
+    nifs = h.get("nifs", 1)
+    nbits = h.get("nbits", 32)
+    fch1, foff = h["fch1"], h["foff"]
+    centres = fch1 + np.arange(nchans) * foff
+    h["bandwidth"] = abs(foff) * nchans
+    h["fbottom"] = float(centres.min() - abs(foff) / 2)
+    h["ftop"] = float(centres.max() + abs(foff) / 2)
+    bytes_per_sample = nchans * nifs * nbits // 8
+    available = int(data_size_bytes // bytes_per_sample)
+    if "nsamples" not in h or h["nsamples"] <= 0:
+        h["nsamples"] = available
+    else:
+        # a truncated data section (interrupted write / partial transfer)
+        # must not crash the memmap — read what is actually present
+        h["nsamples"] = min(int(h["nsamples"]), available)
+    h.setdefault("tstart", 0.0)
+    return h
+
+
+class FilterbankReader:
+    """Memory-mapped SIGPROC filterbank reader.
+
+    ``read_block(istart, n)`` returns a float ``(nchans, n)`` array in
+    **ascending frequency order** when ``band_ascending=True`` (default
+    False returns file order) — the reference flips descending bands by
+    hand in its chunk loop (``clean.py:332-333``); the flag folds that in.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        raw_header, offset = read_header(path)
+        data_size = os.path.getsize(path) - offset
+        self.header = derived_header(raw_header, data_size)
+        nbits = self.header.get("nbits", 32)
+        if nbits not in _DTYPES:
+            raise ValueError(f"unsupported nbits={nbits}")
+        self._dtype = _DTYPES[nbits]
+        nifs = self.header.get("nifs", 1)
+        if nifs != 1:
+            raise NotImplementedError("nifs > 1 not supported")
+        self._mmap = np.memmap(path, dtype=self._dtype, mode="r",
+                               offset=offset,
+                               shape=(self.header["nsamples"],
+                                      self.header["nchans"]))
+
+    @property
+    def nsamples(self):
+        return self.header["nsamples"]
+
+    @property
+    def nchans(self):
+        return self.header["nchans"]
+
+    @property
+    def band_descending(self):
+        return self.header["foff"] < 0
+
+    def read_block(self, istart, nsamps, band_ascending=False):
+        istart = int(istart)
+        nsamps = int(min(nsamps, self.nsamples - istart))
+        block = np.asarray(self._mmap[istart:istart + nsamps]).T.astype(float)
+        if band_ascending and self.band_descending:
+            block = block[::-1]
+        return block
+
+    def readBlock(self, istart, nsamps, as_filterbankBlock=False,
+                  band_ascending=False):
+        """sigpyproc-compatible alias: the reference calls
+        ``readBlock(istart, size, as_filterbankBlock=False)``
+        (reference ``stats.py:44``, ``clean.py:327``); the flag is accepted
+        and ignored (plain arrays are always returned)."""
+        return self.read_block(istart, nsamps, band_ascending=band_ascending)
+
+    def iter_blocks(self, chunksize, band_ascending=False):
+        """Yield ``(istart, block)`` over the whole file."""
+        for istart in range(0, self.nsamples, chunksize):
+            yield istart, self.read_block(istart, chunksize,
+                                          band_ascending=band_ascending)
+
+
+class FilterbankWriter:
+    """Streaming SIGPROC filterbank writer (time-major frames)."""
+
+    def __init__(self, path, header):
+        self.path = path
+        self.header = dict(header)
+        self.nchans = int(self.header["nchans"])
+        self.nbits = int(self.header.get("nbits", 32))
+        if self.nbits not in _DTYPES:
+            raise ValueError(f"unsupported nbits={self.nbits}")
+        self._dtype = _DTYPES[self.nbits]
+        self._file = open(path, "wb")
+        self._nsamples_written = 0
+        self._file.write(_pack_string("HEADER_START"))
+        for key in sorted(set(self.header) & (_INT_KEYS | _DOUBLE_KEYS |
+                                              _STR_KEYS)):
+            if key == "nsamples":
+                continue  # computed from data size on read
+            self._file.write(_pack_record(key, self.header[key]))
+        self._file.write(_pack_string("HEADER_END"))
+
+    def write_block(self, block):
+        """Write a ``(nchans, n)`` block (channel-major in, time-major out)."""
+        block = np.asarray(block)
+        if block.shape[0] != self.nchans:
+            raise ValueError(f"block has {block.shape[0]} channels, "
+                             f"expected {self.nchans}")
+        frames = np.ascontiguousarray(block.T)
+        if self.nbits < 32:
+            info = np.iinfo(self._dtype)
+            frames = np.clip(np.rint(frames), info.min, info.max)
+        self._file.write(frames.astype(self._dtype).tobytes())
+        self._nsamples_written += block.shape[1]
+
+    def close(self):
+        if not self._file.closed:
+            self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_filterbank(path, data, tsamp, fch1, foff, nbits=32, tstart=0.0,
+                     source_name="pulsarutils_tpu", **extra):
+    """Write a whole ``(nchans, nsamples)`` array as a filterbank file."""
+    data = np.asarray(data)
+    header = {
+        "nchans": data.shape[0],
+        "nbits": nbits,
+        "nifs": 1,
+        "tsamp": tsamp,
+        "fch1": fch1,
+        "foff": foff,
+        "tstart": tstart,
+        "source_name": source_name,
+        "machine_id": 0,
+        "telescope_id": 0,
+        "data_type": 1,
+    }
+    header.update(extra)
+    with FilterbankWriter(path, header) as w:
+        w.write_block(data)
+    return header
+
+
+def write_simulated_filterbank(path, array, sim_header, descending=False,
+                               **extra):
+    """Write a simulator-convention array (ascending band, row i = lowest
+    frequency first) as a filterbank file, handling the row flip a
+    descending-band header requires.
+
+    Use this instead of composing :func:`write_filterbank` +
+    :func:`header_from_simulated` by hand — forgetting the row flip for
+    ``descending=True`` silently corrupts the band orientation and ruins
+    DM recovery.
+    """
+    data = np.asarray(array)[::-1] if descending else array
+    kw = header_from_simulated(sim_header, descending=descending)
+    kw.update(extra)
+    return write_filterbank(path, data, **kw)
+
+
+def header_from_simulated(sim_header, descending=False):
+    """Map a simulator header (ascending-band, band-edge keys) onto writer
+    kwargs (``fch1``/``foff`` channel-centre convention)."""
+    nchan = sim_header["nchans"]
+    df = sim_header["bandwidth"] / nchan
+    if descending:
+        fch1 = sim_header["fbottom"] + sim_header["bandwidth"] - df / 2
+        foff = -df
+    else:
+        fch1 = sim_header["fbottom"] + df / 2
+        foff = df
+    return {"tsamp": sim_header["tsamp"], "fch1": fch1, "foff": foff}

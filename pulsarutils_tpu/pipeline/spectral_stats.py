@@ -1,0 +1,154 @@
+"""Streaming bandpass statistics and bad-channel detection.
+
+Capability-equivalents of the reference's L2 stats layer
+(``pulsarutils/stats.py:35-90``):
+
+* :func:`get_spectral_stats` — one-pass mean & std bandpass spectra via
+  running ``sum(x)`` / ``sum(x^2)`` moment accumulation over chunks
+  (reference ``stats.py:35-60``).  The accumulation itself is a pure
+  function (:func:`moment_accumulate` / :func:`moments_to_spectra`) so the
+  same logic runs host-side over file chunks or on device inside a
+  ``lax.scan`` (:func:`spectral_stats_scan_jax`) for HBM-resident streams.
+* :func:`get_bad_chans` — flag channels above ``medfilt(spec, 11) +
+  4 * ref_mad(spec)`` on both the mean and std spectra, with a
+  ``.badchans`` text-cache making the computation restartable
+  (reference ``stats.py:63-90``; the deprecated ``np.bool`` alias is
+  simply not an issue here).
+
+Input flexibility: all entry points accept a path to a SIGPROC file, an
+open :class:`~pulsarutils_tpu.io.sigproc.FilterbankReader`, or an in-memory
+``(nchans, nsamples)`` array.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.sigproc import FilterbankReader
+from ..ops.robust import median_filter_1d, ref_mad
+
+
+def _as_reader(source):
+    if isinstance(source, FilterbankReader):
+        return source
+    if isinstance(source, (str, os.PathLike)):
+        return FilterbankReader(source)
+    return None
+
+
+def moment_accumulate(carry, block):
+    """Fold one ``(nchans, n)`` block into running ``(sum, sumsq, count)``.
+
+    Pure function — usable directly as a ``lax.scan`` body.
+    """
+    s, sq, n = carry
+    block_f = block.astype(s.dtype) if hasattr(block, "astype") else block
+    return (s + block_f.sum(axis=1),
+            sq + (block_f ** 2).sum(axis=1),
+            n + block.shape[1])
+
+
+def moments_to_spectra(s, sq, n, xp=np):
+    """Running moments -> (mean spectrum, std spectrum).
+
+    ``std = sqrt(E[x^2] - E[x]^2)`` (reference ``stats.py:55-57``).
+    """
+    mean = s / n
+    var = xp.maximum(sq / n - mean ** 2, 0.0)
+    return mean, xp.sqrt(var)
+
+
+def get_spectral_stats(source, chunksize=10000):
+    """One-pass mean & std bandpass spectra of a filterbank.
+
+    Reference ``stats.py:35-60`` (diagnostic plotting lives in
+    :mod:`..pipeline.diagnostics`, not here).
+    """
+    reader = _as_reader(source)
+    if reader is None:
+        data = np.asarray(source, dtype=float)
+        return data.mean(axis=1), data.std(axis=1)
+
+    nchans = reader.nchans
+    s = np.zeros(nchans)
+    sq = np.zeros(nchans)
+    n = 0
+    for _, block in reader.iter_blocks(chunksize):
+        s, sq, n = moment_accumulate((s, sq, n), block)
+    return moments_to_spectra(s, sq, n)
+
+
+def spectral_stats_scan_jax(chunks):
+    """Device-resident streaming moments: ``chunks`` is
+    ``(nchunks, nchans, chunk_len)``; returns (mean, std) spectra.
+
+    The TPU equivalent of the reference's host chunk loop: a single jitted
+    ``lax.scan`` that keeps the accumulator in HBM.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(chunks):
+        nchans = chunks.shape[1]
+        # Shifted moments: accumulate around a per-channel pivot (the first
+        # chunk's mean) so float32 does not lose the variance to
+        # catastrophic cancellation in E[x^2] - E[x]^2 when the bandpass
+        # baseline is large (the naive formulation costs ~1.5% std error at
+        # baseline ~100; shifted it is exact to f32 rounding).
+        pivot = chunks[0].mean(axis=1)
+        init = (jnp.zeros(nchans, dtype=jnp.float32),
+                jnp.zeros(nchans, dtype=jnp.float32),
+                jnp.zeros((), dtype=jnp.float32))
+
+        def body(carry, block):
+            return moment_accumulate(carry, block - pivot[:, None]), None
+
+        (s, sq, n), _ = jax.lax.scan(body, init, chunks)
+        mean, std = moments_to_spectra(s, sq, n, xp=jnp)
+        return pivot + mean, std
+
+    return run(jnp.asarray(chunks))
+
+
+def flag_bad_channels(mean_spec, std_spec, medfilt_size=11, nsigma=4.0,
+                      xp=np):
+    """Threshold both spectra against their median-filtered baselines.
+
+    Reference ``stats.py:70-77``.  Pure / jit-compatible.
+    """
+    nchan = mean_spec.shape[0]
+    bad = xp.zeros(nchan, dtype=bool)
+    for spec in (mean_spec, std_spec):
+        smooth = median_filter_1d(spec, medfilt_size, xp=xp)
+        sigma = ref_mad(spec, xp=xp)
+        bad = bad | (spec > smooth + nsigma * sigma)
+    return bad
+
+
+def get_bad_chans(source, cache=None, surelybad=(), refresh=False):
+    """Bad-channel mask for a filterbank, with a restartable text cache.
+
+    Reference ``stats.py:63-90`` (cache file ``<fname>.badchans``) plus the
+    ``surelybad`` user override that the reference applied in its chunk
+    driver (``clean.py:280-282``).  Pass ``refresh=True`` to ignore a stale
+    cache.
+    """
+    path = source if isinstance(source, (str, os.PathLike)) else None
+    if cache is None and path is not None:
+        cache = f"{path}.badchans"
+
+    if cache is not None and os.path.exists(cache) and not refresh:
+        bad = np.loadtxt(cache).astype(bool)
+    else:
+        mean_spec, std_spec = get_spectral_stats(source)
+        bad = np.asarray(flag_bad_channels(mean_spec, std_spec))
+        if cache is not None:
+            np.savetxt(cache, [bad.astype(int)], fmt="%d")
+
+    bad = np.array(bad, dtype=bool)
+    for chan in surelybad:
+        bad[int(chan)] = True
+    return bad

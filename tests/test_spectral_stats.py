@@ -1,0 +1,80 @@
+"""Streaming moments + bad-channel cache (file- and device-side)."""
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.io.sigproc import header_from_simulated, write_filterbank
+from pulsarutils_tpu.models.simulate import inject_rfi, simulate_test_data
+from pulsarutils_tpu.pipeline.spectral_stats import (
+    flag_bad_channels,
+    get_bad_chans,
+    get_spectral_stats,
+    spectral_stats_scan_jax,
+)
+
+
+@pytest.fixture()
+def rfi_file(tmp_path):
+    array, sim_header = simulate_test_data(0, nchan=64, nsamples=8192,
+                                           signal=0.0, rng=0)
+    array += 100.0  # realistic positive baseline
+    bad = (5, 30, 31)
+    array = inject_rfi(array, bad_channels=bad, bad_channel_scale=15, rng=1)
+    path = tmp_path / "rfi.fil"
+    write_filterbank(path, array, **header_from_simulated(sim_header))
+    return str(path), array, bad
+
+
+def test_streaming_stats_match_direct(rfi_file):
+    path, array, _ = rfi_file
+    mean_s, std_s = get_spectral_stats(path, chunksize=1000)
+    assert np.allclose(mean_s, array.mean(1), rtol=1e-5)
+    assert np.allclose(std_s, array.std(1), rtol=1e-4)
+
+
+def test_stats_on_array_input(rfi_file):
+    _, array, _ = rfi_file
+    mean_s, std_s = get_spectral_stats(array)
+    assert np.allclose(mean_s, array.mean(1))
+    assert np.allclose(std_s, array.std(1))
+
+
+def test_device_scan_matches_host(rfi_file):
+    _, array, _ = rfi_file
+    chunks = array.astype(np.float32).reshape(64, 8, 1024).transpose(1, 0, 2)
+    mean_j, std_j = spectral_stats_scan_jax(chunks)
+    assert np.allclose(np.asarray(mean_j), array.mean(1), rtol=1e-4)
+    assert np.allclose(np.asarray(std_j), array.std(1), rtol=1e-3)
+
+
+def test_get_bad_chans_finds_and_caches(rfi_file, tmp_path):
+    path, _, bad = rfi_file
+    mask = get_bad_chans(path)
+    assert set(np.flatnonzero(mask)) >= set(bad)
+    # cache file written next to the data
+    import os
+    assert os.path.exists(path + ".badchans")
+    # cache round trip gives the same mask without recomputation
+    mask2 = get_bad_chans(path)
+    assert np.array_equal(mask, mask2)
+
+
+def test_get_bad_chans_surelybad_and_refresh(rfi_file):
+    path, _, bad = rfi_file
+    mask = get_bad_chans(path, surelybad=[0, 63])
+    assert mask[0] and mask[63]
+    mask3 = get_bad_chans(path, refresh=True)
+    assert set(np.flatnonzero(mask3)) >= set(bad)
+
+
+def test_flag_bad_channels_jax():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    mean_spec = rng.normal(100, 1, 64)
+    std_spec = rng.normal(10, 0.1, 64)
+    mean_spec[17] += 50
+    bad_np = flag_bad_channels(mean_spec, std_spec)
+    bad_j = flag_bad_channels(jnp.asarray(mean_spec), jnp.asarray(std_spec),
+                              xp=jnp)
+    assert bad_np[17]
+    assert np.array_equal(np.asarray(bad_j), bad_np)
