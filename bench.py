@@ -1,0 +1,133 @@
+"""Benchmark: DM-trials/sec of the TPU dedispersion sweep vs single-core NumPy.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "DM-trials/sec", "vs_baseline": N, ...}
+
+Headline configuration (BASELINE.json config 2): 1024 channels x 1M samples,
+512 DM trials, single chip.  The NumPy baseline (the reference algorithm's
+vectorised single-core form: per-trial gather + channel sum + 4-window
+boxcar scoring — semantics of reference ``pulsarutils/dedispersion.py:
+174-202``) is measured on reduced sample counts and extrapolated linearly in
+``nsamples`` (the sweep is O(ndm * nchan * nsamples); linearity is verified
+on two sizes and reported).
+
+Environment knobs:
+  BENCH_PRESET=full|quick   (default full; quick = small shapes for smoke)
+  BENCH_NCHAN, BENCH_NSAMP, BENCH_NDM  (override individual sizes)
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    preset = os.environ.get("BENCH_PRESET", "full")
+    nchan = int(os.environ.get("BENCH_NCHAN", 1024 if preset == "full" else 128))
+    nsamp = int(os.environ.get("BENCH_NSAMP",
+                               1 << 20 if preset == "full" else 1 << 14))
+    ndm = int(os.environ.get("BENCH_NDM", 512 if preset == "full" else 64))
+
+    import jax
+
+    try:
+        devices = jax.devices()
+        platform = devices[0].platform
+    except RuntimeError as exc:  # axon tunnel unavailable -> CPU fallback
+        log(f"accelerator init failed ({exc}); falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+        platform = devices[0].platform
+    log(f"platform: {platform} devices: {devices}")
+
+    import numpy as np
+
+    from pulsarutils_tpu.ops.search import _search_numpy, dedispersion_search
+
+    # ---- data -------------------------------------------------------------
+    log(f"simulating {nchan} x {nsamp} filterbank ...")
+    from pulsarutils_tpu.models.simulate import disperse_array
+
+    rng = np.random.default_rng(0)
+    array = np.abs(rng.normal(0.0, 0.5, (nchan, nsamp))).astype(np.float32)
+    array[:, nsamp // 2] += 1.0
+    start_freq, bandwidth, tsamp = 1200.0, 200.0, 0.0005
+    inject_dm = 350.0
+    array = disperse_array(array, inject_dm, start_freq, bandwidth,
+                           tsamp).astype(np.float32)
+    # an explicit ndm-trial grid around the headline range
+    trial_dms = np.linspace(300.0, 400.0, ndm)
+
+    # ---- JAX path ---------------------------------------------------------
+    dm_block = int(os.environ.get("BENCH_DM_BLOCK", 8))
+    chan_block = int(os.environ.get("BENCH_CHAN_BLOCK", 0)) or None
+
+    def run_jax():
+        return dedispersion_search(
+            array, None, None, start_freq, bandwidth, tsamp,
+            backend="jax", trial_dms=trial_dms, dm_block=dm_block,
+            chan_block=chan_block)
+
+    log("compiling + warming up JAX kernel ...")
+    t0 = time.time()
+    table = run_jax()
+    log(f"first run (incl. compile): {time.time() - t0:.2f}s")
+    t0 = time.time()
+    table = run_jax()
+    jax_time = time.time() - t0
+    jax_tps = ndm / jax_time
+    log(f"JAX steady-state: {jax_time:.3f}s -> {jax_tps:.1f} DM-trials/s")
+
+    # ---- NumPy baseline (reduced + extrapolated) --------------------------
+    base_ndm = min(ndm, 16)
+    base_samp_a = min(nsamp // 2, 1 << 14)
+    base_samp_b = min(nsamp, 1 << 15)
+
+    def numpy_time(ns, nd):
+        sub = np.ascontiguousarray(array[:, :ns]).astype(np.float64)
+        dms = trial_dms[:nd]
+        t0 = time.time()
+        _search_numpy(sub, dms, start_freq, bandwidth, tsamp,
+                      capture_plane=False)
+        return time.time() - t0
+
+    log("measuring NumPy single-core baseline ...")
+    numpy_time(min(nsamp, 2048), 4)  # warm up allocator/page cache
+    t_a = numpy_time(base_samp_a, base_ndm)
+    t_b = numpy_time(base_samp_b, base_ndm)
+    per_trial_a = t_a / base_ndm / base_samp_a
+    per_trial_b = t_b / base_ndm / base_samp_b
+    linearity = per_trial_b / per_trial_a
+    # cost model: time per trial scales linearly in nsamples
+    numpy_time_full_per_trial = per_trial_b * nsamp
+    numpy_tps = 1.0 / numpy_time_full_per_trial
+    log(f"NumPy: {t_a:.2f}s@{base_samp_a}, {t_b:.2f}s@{base_samp_b} "
+        f"(linearity ratio {linearity:.2f}) -> {numpy_tps:.2f} DM-trials/s "
+        f"extrapolated at {nsamp} samples")
+
+    result = {
+        "metric": f"DM-trials/sec, {nchan}-chan x {nsamp}-sample filterbank, "
+                  f"{ndm} trials, backend=jax ({platform})",
+        "value": round(jax_tps, 2),
+        "unit": "DM-trials/sec",
+        "vs_baseline": round(jax_tps / numpy_tps, 2),
+        "baseline": {
+            "what": "single-core NumPy (reference semantics), extrapolated "
+                    "linearly in nsamples from two measured sizes",
+            "dm_trials_per_sec": round(numpy_tps, 4),
+            "linearity_check": round(linearity, 3),
+        },
+        "platform": platform,
+        "best_dm": float(table["DM"][table.argbest()]),
+        "injected_dm": inject_dm,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

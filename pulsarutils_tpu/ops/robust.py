@@ -94,6 +94,14 @@ def z_n_test(profile, n_harmonics, xp=np):
     reserves slots for on its candidate record (``clean.py:43-55``).
     """
     profile = xp.asarray(profile, dtype=float)
+    nbin = profile.shape[0]
+    n_harmonics = int(n_harmonics)
+    if n_harmonics > nbin // 2:
+        # rfft only resolves nbin//2 harmonics; silently summing fewer
+        # would understate the statistic the caller asked for
+        raise ValueError(
+            f"n_harmonics={n_harmonics} exceeds the {nbin // 2} harmonics "
+            f"resolvable in a {nbin}-bin profile")
     total = profile.sum()
     spec = xp.fft.rfft(profile)
     powers = xp.abs(spec[1:n_harmonics + 1]) ** 2

@@ -65,12 +65,46 @@ def score_profiles(plane, xp=np):
     return maxvalues, stds, best_snrs, best_windows
 
 
+#: soft cap on the gather workspace (elements) a single trial-block may
+#: materialise; keeps the kernel HBM-resident at 1M-sample configs
+GATHER_BUDGET_ELEMENTS = 1 << 28
+
+
+def auto_chan_block(nchan, nsamples, dm_block):
+    """Largest power-of-two channel block that (a) divides ``nchan`` and
+    (b) keeps ``dm_block * chan_block * nsamples`` under the gather budget.
+
+    Returns ``None`` (no chunking) when the whole channel axis fits.
+    """
+    if dm_block * nchan * nsamples <= GATHER_BUDGET_ELEMENTS:
+        return None
+    block = 1
+    candidate = 2
+    while candidate <= nchan:
+        if (nchan % candidate == 0
+                and dm_block * candidate * nsamples <= GATHER_BUDGET_ELEMENTS):
+            block = candidate
+        candidate *= 2
+    return block
+
+
 def _offsets_for(trial_dms, nchan, start_freq, bandwidth, sample_time, nsamples):
     """Host-side float64 shift table -> int32 gather offsets in ``[0, T)``."""
     shifts = dedispersion_shifts_batch(
         np.asarray(trial_dms, dtype=np.float64), nchan, start_freq, bandwidth,
         sample_time)
     return normalize_shifts(shifts, nsamples)
+
+
+def block_offsets(offsets, dm_block):
+    """Pad the trial axis to a multiple of ``dm_block`` (duplicating the
+    last trial — sliced off after the kernel) and reshape to the
+    ``(nblocks, dm_block, nchan)`` layout :func:`search_kernel_fn` takes."""
+    ndm, nchan = offsets.shape
+    npad = (-ndm) % dm_block
+    if npad:
+        offsets = np.concatenate([offsets, offsets[-1:].repeat(npad, axis=0)])
+    return offsets.reshape(-1, dm_block, nchan)
 
 
 # ---------------------------------------------------------------------------
@@ -112,22 +146,39 @@ def _search_numpy(data, trial_dms, start_freq, bandwidth, sample_time,
 # JAX backend
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=32)
-def _jax_search_kernel(capture_plane, chan_block):
+def search_kernel_fn(data, offset_blocks, capture_plane=False,
+                     chan_block=None):
+    """The pure, jittable forward step of the search (flagship kernel).
+
+    ``data`` is ``(nchan, T)``; ``offset_blocks`` is
+    ``(nblocks, dm_block, nchan)`` int32 gather offsets.  Returns the
+    per-block score arrays (and the dedispersed plane blocks when
+    ``capture_plane``).  Traceable under ``jit``/``shard_map``; the blocks
+    are processed by ``lax.map`` so the compiled program is independent of
+    the trial count.
+    """
     import jax
     import jax.numpy as jnp
 
-    def per_block(data, offs):
+    def per_block(offs):
         plane = dedisperse_block_chunked_jax(data, offs, chan_block)
         scores = score_profiles(plane, xp=jnp)
         if capture_plane:
             return scores + (plane,)
         return scores
 
+    return jax.lax.map(per_block, offset_blocks)
+
+
+@functools.lru_cache(maxsize=32)
+def _jax_search_kernel(capture_plane, chan_block):
+    import jax
+
     @jax.jit
     def kernel(data, offset_blocks):
-        # data (C, T); offset_blocks (nblocks, dm_block, C) int32
-        return jax.lax.map(lambda offs: per_block(data, offs), offset_blocks)
+        return search_kernel_fn(data, offset_blocks,
+                                capture_plane=capture_plane,
+                                chan_block=chan_block)
 
     return kernel
 
@@ -145,10 +196,9 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
     if dm_block is None:
         dm_block = max(1, min(ndm, 32))
-    npad = (-ndm) % dm_block
-    if npad:
-        offsets = np.concatenate([offsets, offsets[-1:].repeat(npad, axis=0)])
-    offset_blocks = offsets.reshape(-1, dm_block, nchan)
+    if chan_block is None:
+        chan_block = auto_chan_block(nchan, nsamples, dm_block)
+    offset_blocks = block_offsets(offsets, dm_block)
 
     kernel = _jax_search_kernel(capture_plane, chan_block)
     out = kernel(data, jnp.asarray(offset_blocks))

@@ -1,0 +1,177 @@
+"""Sharded sweep + ring streaming on the 8-virtual-device CPU mesh.
+
+The "fake cluster" tests of SURVEY §4: same results as the single-device
+path, through real shard_map/psum/ppermute programs.
+"""
+import numpy as np
+import pytest
+
+from pulsarutils_tpu import dedispersion_search, simulate_test_data
+from pulsarutils_tpu.ops.dedisperse import dedisperse_batch_numpy
+from pulsarutils_tpu.ops.plan import dedispersion_plan, dedispersion_shifts_batch
+from pulsarutils_tpu.parallel.mesh import (
+    balanced_2d_mesh,
+    make_mesh,
+    pad_to_multiple,
+)
+from pulsarutils_tpu.parallel.sharded import sharded_dedispersion_search
+from pulsarutils_tpu.parallel.stream import (
+    iter_chunk_starts,
+    plan_chunks,
+    ring_dedisperse,
+    stream_search,
+)
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return simulate_test_data(150, rng=77)
+
+
+def test_make_mesh_shapes():
+    import jax
+
+    mesh = make_mesh()
+    assert mesh.shape["dm"] == len(jax.devices())
+    mesh2 = make_mesh((4, 2))
+    assert mesh2.shape == {"dm": 4, "chan": 2}
+    mesh3 = make_mesh((-1, 2))
+    assert mesh3.shape["dm"] == len(jax.devices()) // 2
+    with pytest.raises(ValueError):
+        make_mesh((64, 2))
+
+
+def test_pad_to_multiple():
+    x = np.arange(10).reshape(5, 2)
+    padded, n = pad_to_multiple(x, 0, 4, mode="edge")
+    assert padded.shape == (8, 2) and n == 5
+    assert np.all(padded[5:] == x[-1])
+    same, n2 = pad_to_multiple(x, 0, 5)
+    assert same is x and n2 == 5
+
+
+def test_sharded_matches_single_device(sim):
+    array, header = sim
+    args = (array, 100, 200., header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+    t_ref = dedispersion_search(*args, backend="jax")
+    for shape in [(8, 1), (4, 2), (2, 4), (1, 8)]:
+        mesh = make_mesh(shape)
+        t_sh = sharded_dedispersion_search(*args, mesh=mesh)
+        assert t_sh.argbest() == t_ref.argbest(), shape
+        assert np.allclose(t_sh["snr"], t_ref["snr"], rtol=1e-4), shape
+        assert np.array_equal(t_sh["rebin"], t_ref["rebin"]), shape
+    assert np.isclose(t_ref["DM"][t_ref.argbest()], 150, atol=1)
+
+
+def test_sharded_plane_capture(sim):
+    array, header = sim
+    mesh = balanced_2d_mesh()
+    t_sh, plane = sharded_dedispersion_search(
+        array, 100, 200., header["fbottom"], header["bandwidth"],
+        header["tsamp"], mesh=mesh, capture_plane=True)
+    _, plane_ref = dedispersion_search(
+        array, 100, 200., header["fbottom"], header["bandwidth"],
+        header["tsamp"], backend="jax", capture_plane=True)
+    assert np.allclose(np.asarray(plane), plane_ref, atol=1e-3)
+
+
+def test_sharded_with_uneven_sizes():
+    # trial count and channel count not divisible by the mesh axes
+    array, header = simulate_test_data(120, nchan=100, nsamples=512, rng=3)
+    mesh = make_mesh((4, 2))
+    t_sh = sharded_dedispersion_search(
+        array, 100, 140., header["fbottom"], header["bandwidth"],
+        header["tsamp"], mesh=mesh)
+    t_ref = dedispersion_search(
+        array, 100, 140., header["fbottom"], header["bandwidth"],
+        header["tsamp"], backend="numpy")
+    assert t_sh.nrows == t_ref.nrows
+    assert t_sh.argbest() == t_ref.argbest()
+    assert np.isclose(t_sh["DM"][t_sh.argbest()], 120, atol=1)
+
+
+def test_ring_dedisperse_matches_global(sim):
+    array, header = sim
+    mesh = make_mesh((8,), ("time",))
+    dms = dedispersion_plan(array.shape[0], 100, 200., header["fbottom"],
+                            header["bandwidth"], header["tsamp"])[:16]
+    plane_ring = np.asarray(ring_dedisperse(
+        array, dms, header["fbottom"], header["bandwidth"], header["tsamp"],
+        mesh))
+    shifts = dedispersion_shifts_batch(dms, array.shape[0],
+                                       header["fbottom"],
+                                       header["bandwidth"], header["tsamp"])
+    plane_ref = dedisperse_batch_numpy(array, shifts)
+    assert plane_ring.shape == plane_ref.shape
+    assert np.allclose(plane_ring, plane_ref, rtol=1e-4, atol=1e-3)
+
+
+def test_ring_multihop_span_larger_than_slice():
+    # span (~229 samples at DM 150) far exceeds the per-device slice of 32:
+    # the ring must take multiple hops and still match the global result
+    array, header = simulate_test_data(150, nchan=16, nsamples=256, rng=4)
+    mesh = make_mesh((8,), ("time",))
+    dms = np.array([140.0, 150.0, 160.0])
+    plane_ring = np.asarray(ring_dedisperse(
+        array, dms, header["fbottom"], header["bandwidth"], header["tsamp"],
+        mesh))
+    shifts = dedispersion_shifts_batch(dms, 16, header["fbottom"],
+                                       header["bandwidth"], header["tsamp"])
+    plane_ref = dedisperse_batch_numpy(array, shifts)
+    assert np.allclose(plane_ring, plane_ref, rtol=1e-4, atol=1e-3)
+
+
+def test_ring_rejects_span_larger_than_sequence():
+    array, header = simulate_test_data(150, nchan=32, nsamples=256, rng=4)
+    mesh = make_mesh((8,), ("time",))
+    # huge DM -> intra-band span exceeds the whole chunk
+    with pytest.raises(ValueError, match="exceeds the sequence length"):
+        ring_dedisperse(array, [3000.0], header["fbottom"],
+                        header["bandwidth"], header["tsamp"], mesh)
+
+
+def test_plan_chunks_physics():
+    plan = plan_chunks(nsamples=1_000_000, sample_time=0.0005, dmmin=300,
+                       dmmax=400, start_freq=1200., stop_freq=1400.,
+                       foff=200. / 1024)
+    from pulsarutils_tpu.ops.plan import delta_delay, dm_broadening
+    expected_delay = delta_delay(400, 1200., 1400.)
+    assert plan.step == max(int(expected_delay / 0.0005) * 2, 128)
+    assert plan.hop == plan.step // 2
+    # resampling targets dm_broadening(dmmin)/10
+    dt = dm_broadening(300, 1200., 200. / 1024)
+    assert plan.resample == int(np.rint(max(dt / 10, 0.0005) / 0.0005))
+
+
+def test_iter_chunk_starts_overlap_and_tail():
+    from pulsarutils_tpu.parallel.stream import ChunkPlan
+    plan = ChunkPlan(step=100, hop=50, resample=1, sample_time=1.0)
+    starts = list(iter_chunk_starts(320, plan))
+    # last start yielding >= 50 samples is 270; 300 leaves only 20
+    assert starts == [0, 50, 100, 150, 200, 250]
+    # tmin skips early chunks
+    starts_t = list(iter_chunk_starts(320, plan, tmin=120, sample_time=1.0))
+    assert starts_t == [150, 200, 250]
+
+
+def test_stream_search_finds_pulse_in_right_chunk():
+    # long series with one pulse; 50% overlap chunking must localise it
+    rng = np.random.default_rng(5)
+    nchan, nsamples = 32, 4096
+    array = np.abs(rng.normal(0, 0.5, (nchan, nsamples)))
+    array[:, 2500] += 2.0
+    from pulsarutils_tpu.models.simulate import disperse_array
+    array = disperse_array(array, 150, 1200., 200., 0.0005)
+
+    step, hop = 1024, 512
+    chunks = [(s, array[:, s:s + step]) for s in range(0, nsamples - hop, hop)
+              if array[:, s:s + step].shape[1] == step]
+    results, hits = stream_search(chunks, 100, 200., 1200., 200., 0.0005,
+                                  snr_threshold=6.0)
+    assert len(hits) >= 1
+    hit_starts = [h[0] for h in hits]
+    assert any(s <= 2500 < s + step for s in hit_starts)
+    # at least one hit (the chunk fully containing the pulse) nails the DM;
+    # overlapping neighbours see a wrapped pulse and may be slightly off
+    assert any(np.isclose(best["DM"], 150, atol=2) for _, _, best in hits)

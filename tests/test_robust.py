@@ -150,3 +150,9 @@ def test_digitize_integer_passthrough_jax():
     ints = jnp.arange(10)
     out = digitize(ints, xp=jnp)
     assert np.array_equal(np.asarray(out), np.arange(10))
+
+
+def test_z_n_test_rejects_unresolvable_harmonics():
+    prof = np.ones(16)
+    with pytest.raises(ValueError, match="harmonics"):
+        z_n_test(prof, 10)
