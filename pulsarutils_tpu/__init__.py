@@ -47,6 +47,34 @@ from .ops.search import dedispersion_search
 from .models.simulate import simulate_test_data
 from .utils.table import ResultTable
 
+
+def __getattr__(name):
+    """Lazy re-exports of the pipeline/IO layer (keeps bare ``import
+    pulsarutils_tpu`` light — no matplotlib / file machinery)."""
+    lazy = {
+        "search_by_chunks": ("pipeline.search_pipeline", "search_by_chunks"),
+        "cleanup_data": ("pipeline.cleanup", "cleanup_data"),
+        "get_bad_chans": ("pipeline.spectral_stats", "get_bad_chans"),
+        "get_spectral_stats": ("pipeline.spectral_stats",
+                               "get_spectral_stats"),
+        "PulseInfo": ("pipeline.pulse_info", "PulseInfo"),
+        "plot_diagnostics": ("pipeline.diagnostics", "plot_diagnostics"),
+        "FilterbankReader": ("io.sigproc", "FilterbankReader"),
+        "FilterbankWriter": ("io.sigproc", "FilterbankWriter"),
+        "write_filterbank": ("io.sigproc", "write_filterbank"),
+        "CandidateStore": ("io.candidates", "CandidateStore"),
+        "sharded_dedispersion_search": ("parallel.sharded",
+                                        "sharded_dedispersion_search"),
+        "ring_dedisperse": ("parallel.stream", "ring_dedisperse"),
+        "make_mesh": ("parallel.mesh", "make_mesh"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(f".{module}", __name__), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "__version__",
     "DM_DELAY_CONST",

@@ -1,0 +1,10 @@
+"""Console entry points.
+
+Mirrors the reference's three scripts (``setup.cfg:36-40``): ``PUstats``
+(bad-channel detection), ``PUsearchfrb`` (chunked single-pulse search) and
+``PUclean`` (write a cleaned filterbank — actually implemented here; the
+reference's was a stub).  Unlike the reference, every scientific knob is a
+real flag instead of a hardcoded kwarg (reference ``clean.py:372`` pinned
+``dmmin=300, dmmax=400`` for all users; those remain the defaults for
+``PUsearchfrb`` parity).
+"""

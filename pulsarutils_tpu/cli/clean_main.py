@@ -1,0 +1,41 @@
+"""``PUclean`` — write cleaned filterbank files.
+
+Reference counterpart: ``pulsarutils/clean.py:375-388`` — whose actual
+cleaning function was an empty stub (``clean.py:354-357``); this one
+really writes the cleaned file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ..pipeline.cleanup import cleanup_data
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser(
+        description="Zero bad channels (and optionally Fourier-zap periodic "
+                    "RFI) and write cleaned filterbank files")
+    parser.add_argument("fnames", nargs="+",
+                        help="input SIGPROC filterbank files")
+    parser.add_argument("-o", "--output", default=None,
+                        help="output file (single input) or directory; "
+                             "default: <input>_clean.fil")
+    parser.add_argument("--surelybad", type=int, nargs="*", default=[])
+    parser.add_argument("--fft-zap", action="store_true")
+    parser.add_argument("--chunksize", type=int, default=65536)
+    opts = parser.parse_args(args)
+
+    for fname in opts.fnames:
+        if opts.output and len(opts.fnames) == 1 and \
+                not os.path.isdir(opts.output):
+            outname = opts.output
+        else:
+            stem, ext = os.path.splitext(os.path.basename(fname))
+            outdir = opts.output if opts.output else os.path.dirname(
+                os.path.abspath(fname))
+            outname = os.path.join(outdir, f"{stem}_clean{ext or '.fil'}")
+        cleanup_data(fname, outname, surelybad=opts.surelybad,
+                     fft_zap=opts.fft_zap, chunksize=opts.chunksize)
+    return 0

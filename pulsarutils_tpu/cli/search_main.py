@@ -1,0 +1,69 @@
+"""``PUsearchfrb`` — chunked dispersed-pulse search over filterbank files.
+
+Reference counterpart: ``pulsarutils/clean.py:360-373`` (which hardcoded
+``dmmin=300, dmmax=400``; kept as defaults, now overridable).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..pipeline.search_pipeline import search_by_chunks
+from ..utils.logging_utils import logger
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        description="Clean filterbank data and search for FRBs/single pulses")
+    parser.add_argument("fnames", nargs="+",
+                        help="input SIGPROC filterbank files")
+    parser.add_argument("--dmmin", type=float, default=300.0)
+    parser.add_argument("--dmmax", type=float, default=400.0)
+    parser.add_argument("--sample-time", type=float, default=None,
+                        help="resample to this sample time (s); default "
+                             "auto from DM smearing")
+    parser.add_argument("--chunk-length", type=float, default=None,
+                        help="chunk length in seconds; default = band "
+                             "crossing delay at dmmax")
+    parser.add_argument("--tmin", type=float, default=0.0,
+                        help="skip data before this time (s)")
+    parser.add_argument("--snr-threshold", type=float, default=6.0)
+    parser.add_argument("--surelybad", type=int, nargs="*", default=[])
+    parser.add_argument("--backend", choices=("jax", "numpy"), default="jax")
+    parser.add_argument("--fft-zap", action="store_true",
+                        help="excise periodic RFI in the Fourier domain")
+    parser.add_argument("--cut-outliers", action="store_true",
+                        help="zero broadband outlier time bins")
+    parser.add_argument("--output-dir", default=None)
+    parser.add_argument("--plots", choices=("hits", "all", "none"),
+                        default="hits")
+    parser.add_argument("--no-resume", action="store_true",
+                        help="reprocess chunks already in the ledger")
+    parser.add_argument("--max-chunks", type=int, default=None)
+    return parser
+
+
+def main(args=None):
+    opts = build_parser().parse_args(args)
+    total_hits = 0
+    for fname in opts.fnames:
+        hits, _ = search_by_chunks(
+            fname,
+            chunk_length=opts.chunk_length,
+            new_sample_time=opts.sample_time,
+            tmin=opts.tmin,
+            dmmin=opts.dmmin,
+            dmmax=opts.dmmax,
+            surelybad=opts.surelybad,
+            backend=opts.backend,
+            snr_threshold=opts.snr_threshold,
+            output_dir=opts.output_dir,
+            make_plots=False if opts.plots == "none" else opts.plots,
+            resume=not opts.no_resume,
+            fft_zap=opts.fft_zap,
+            cut_outliers=opts.cut_outliers,
+            max_chunks=opts.max_chunks,
+        )
+        total_hits += len(hits)
+    logger.info("total candidates: %d", total_hits)
+    return 0

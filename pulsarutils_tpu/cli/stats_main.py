@@ -1,0 +1,58 @@
+"""``PUstats`` — bandpass statistics and bad-channel flagging.
+
+Reference counterpart: ``pulsarutils/stats.py:93-101``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from ..pipeline.spectral_stats import get_bad_chans, get_spectral_stats
+from ..utils.logging_utils import logger
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser(
+        description="Detect bad (RFI-loud) channels in filterbank files")
+    parser.add_argument("fnames", nargs="+",
+                        help="input SIGPROC filterbank files")
+    parser.add_argument("--refresh", action="store_true",
+                        help="ignore any cached .badchans file")
+    parser.add_argument("--surelybad", type=int, nargs="*", default=[],
+                        help="channel indices to force-flag")
+    parser.add_argument("--plot", metavar="OUT.png", default=None,
+                        help="save a bandpass diagnostic plot")
+    opts = parser.parse_args(args)
+
+    for fname in opts.fnames:
+        # one pass over the file serves both flagging and plotting
+        spectra = get_spectral_stats(fname) if opts.plot else None
+        mask = get_bad_chans(fname, surelybad=opts.surelybad,
+                             refresh=opts.refresh, spectra=spectra)
+        logger.info("%s: %d bad channels: %s", fname, mask.sum(),
+                    np.flatnonzero(mask).tolist())
+        if opts.plot:
+            _plot_bandpass(spectra, mask, opts.plot)
+    return 0
+
+
+def _plot_bandpass(spectra, mask, outname):
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    mean_spec, std_spec = spectra
+    chans = np.arange(mean_spec.size)
+    fig, axes = plt.subplots(2, 1, sharex=True, figsize=(8, 6))
+    for ax, spec, label in ((axes[0], mean_spec, "mean"),
+                            (axes[1], std_spec, "std")):
+        ax.plot(chans, spec, drawstyle="steps-mid", color="grey", lw=0.8)
+        ax.plot(chans[mask], spec[mask], "rx", ms=4)
+        ax.set_ylabel(f"{label} bandpass")
+    axes[1].set_xlabel("channel")
+    fig.savefig(outname, bbox_inches="tight")
+    plt.close(fig)
+    logger.info("bandpass plot -> %s", outname)

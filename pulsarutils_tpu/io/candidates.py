@@ -1,0 +1,103 @@
+"""Candidate store with deterministic resume.
+
+The reference persisted candidates as ad-hoc pickles named
+``{root}_{istart}-{iend}.pkl`` (``pulsarutils/clean.py:349-351``) and had no
+way to resume a crashed search except a manual ``tmin`` (``clean.py:276``,
+SURVEY §5).  This store makes both first-class:
+
+* candidates are npz records (:class:`..pipeline.pulse_info.PulseInfo`)
+  plus the chunk's full result table, named by chunk index — safe to load,
+  idempotent to rewrite;
+* a ``progress.json`` ledger records every *processed* chunk (hit or not),
+  keyed by a config fingerprint, so a restarted search skips exactly the
+  work already done and redoes nothing else.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from ..pipeline.pulse_info import PulseInfo
+from ..utils.table import ResultTable
+
+
+def config_fingerprint(**kwargs):
+    """Stable hash of the search configuration; a resume ledger is only
+    valid for identical configuration."""
+    blob = json.dumps(kwargs, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class CandidateStore:
+    """``fingerprint=None`` disables the resume ledger entirely (every
+    chunk reports not-done, nothing is recorded) — a no-resume run must
+    never pollute another configuration's ledger.  Each fingerprint gets
+    its own ledger file, so interleaved runs over different files/configs
+    in one output directory never invalidate each other."""
+
+    def __init__(self, directory, fingerprint=None):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.fingerprint = fingerprint
+        if fingerprint is None:
+            self._ledger_path = None
+            self._ledger = {"fingerprint": None, "done": []}
+        else:
+            self._ledger_path = os.path.join(
+                self.directory, f"progress_{fingerprint}.json")
+            self._ledger = self._load_ledger()
+
+    def _load_ledger(self):
+        if os.path.exists(self._ledger_path):
+            with open(self._ledger_path) as f:
+                return json.load(f)
+        return {"fingerprint": self.fingerprint, "done": []}
+
+    # -- resume ledger -------------------------------------------------------
+
+    def is_done(self, istart):
+        if self.fingerprint is None:
+            return False
+        return istart in self._ledger["done"]
+
+    def mark_done(self, istart):
+        if self.fingerprint is None:
+            return
+        if istart not in self._ledger["done"]:
+            self._ledger["done"].append(istart)
+            tmp = self._ledger_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._ledger, f)
+            os.replace(tmp, self._ledger_path)  # atomic: crash-safe resume
+
+    @property
+    def done_chunks(self):
+        return sorted(self._ledger["done"])
+
+    # -- candidates ----------------------------------------------------------
+
+    def _base(self, root, istart, iend):
+        return os.path.join(self.directory, f"{root}_{istart}-{iend}")
+
+    def save_candidate(self, root, istart, iend, info: PulseInfo,
+                       table: ResultTable):
+        base = self._base(root, istart, iend)
+        info.save(base + ".info.npz")
+        table.to_npz(base + ".table.npz")
+        return base
+
+    def load_candidate(self, root, istart, iend):
+        base = self._base(root, istart, iend)
+        return (PulseInfo.load(base + ".info.npz"),
+                ResultTable.from_npz(base + ".table.npz"))
+
+    def candidates(self):
+        """Yield ``(root, istart, iend)`` for every stored candidate."""
+        for name in sorted(os.listdir(self.directory)):
+            if name.endswith(".info.npz"):
+                stem = name[: -len(".info.npz")]
+                root, _, span = stem.rpartition("_")
+                lo, _, hi = span.partition("-")
+                yield root, int(lo), int(hi)

@@ -1,0 +1,47 @@
+"""Write a cleaned copy of a filterbank file.
+
+The reference declared this capability and left it a stub
+(``pulsarutils/clean.py:354-357``: opens the file, computes the mask, does
+nothing).  Implemented here for real: stream the file in chunks, zero the
+flagged channels, optionally excise periodic RFI in the Fourier domain,
+and write a valid SIGPROC file with the same header/geometry.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.sigproc import FilterbankReader, FilterbankWriter, read_header
+from ..ops.clean_ops import fft_zap_time
+from ..pipeline.spectral_stats import get_bad_chans
+from ..utils.logging_utils import logger
+
+
+def cleanup_data(fname, outname, surelybad=(), fft_zap=False,
+                 chunksize=65536):
+    """Stream-clean ``fname`` into ``outname``.
+
+    Bad channels (``get_bad_chans`` + ``surelybad``) are zeroed; with
+    ``fft_zap`` each chunk additionally passes through
+    :func:`..ops.clean_ops.fft_zap_time`.  Channel order, header and bit
+    depth are preserved.  Returns the bad-channel mask (file order).
+    """
+    mask = get_bad_chans(fname, surelybad=surelybad)
+    reader = FilterbankReader(fname)
+    raw_header, _ = read_header(fname)
+    raw_header.setdefault("nbits", reader.header.get("nbits", 32))
+
+    nzapped = 0
+    with FilterbankWriter(outname, raw_header) as writer:
+        for istart, block in reader.iter_blocks(chunksize):
+            block = block.copy()
+            block[mask, :] = 0.0
+            if fft_zap:
+                block, zapped = fft_zap_time(block)
+                block[mask, :] = 0.0  # irfft reintroduces tiny leakage
+                nzapped += int(np.asarray(zapped).sum())
+            writer.write_block(block)
+    logger.info("cleaned %s -> %s (%d bad channels%s)", fname, outname,
+                int(mask.sum()),
+                f", {nzapped} Fourier bins zapped" if fft_zap else "")
+    return mask

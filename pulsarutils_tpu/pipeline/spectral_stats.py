@@ -128,22 +128,26 @@ def flag_bad_channels(mean_spec, std_spec, medfilt_size=11, nsigma=4.0,
     return bad
 
 
-def get_bad_chans(source, cache=None, surelybad=(), refresh=False):
+def get_bad_chans(source, cache=None, surelybad=(), refresh=False,
+                  spectra=None):
     """Bad-channel mask for a filterbank, with a restartable text cache.
 
     Reference ``stats.py:63-90`` (cache file ``<fname>.badchans``) plus the
     ``surelybad`` user override that the reference applied in its chunk
     driver (``clean.py:280-282``).  Pass ``refresh=True`` to ignore a stale
-    cache.
+    cache, or ``spectra=(mean, std)`` to reuse already-computed bandpass
+    spectra instead of streaming the file again.
     """
     path = source if isinstance(source, (str, os.PathLike)) else None
     if cache is None and path is not None:
         cache = f"{path}.badchans"
 
-    if cache is not None and os.path.exists(cache) and not refresh:
+    if spectra is None and cache is not None and os.path.exists(cache) \
+            and not refresh:
         bad = np.loadtxt(cache).astype(bool)
     else:
-        mean_spec, std_spec = get_spectral_stats(source)
+        mean_spec, std_spec = spectra if spectra is not None \
+            else get_spectral_stats(source)
         bad = np.asarray(flag_bad_channels(mean_spec, std_spec))
         if cache is not None:
             np.savetxt(cache, [bad.astype(int)], fmt="%d")

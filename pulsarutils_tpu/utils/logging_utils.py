@@ -1,0 +1,60 @@
+"""Framework logger + per-stage timing.
+
+The reference's observability was ``astropy.log.info`` milestones, bare
+prints and tqdm bars (SURVEY §5).  Here: one stdlib logger plus a tiny
+stage profiler that also hooks ``jax.profiler`` traces when requested.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+logger = logging.getLogger("pulsarutils_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s: %(message)s", "%H:%M:%S"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage; ``report()`` logs a table."""
+
+    def __init__(self):
+        self.totals = {}
+        self.counts = {}
+
+    @contextlib.contextmanager
+    def stage(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self, log=logger):
+        for name, total in sorted(self.totals.items(), key=lambda kv: -kv[1]):
+            n = self.counts[name]
+            log.info("stage %-20s %8.3fs total, %6d calls, %8.4fs/call",
+                     name, total, n, total / n)
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir=None):
+    """Wrap a block in a ``jax.profiler`` trace when ``trace_dir`` is set;
+    no-op otherwise (safe on any backend)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(str(trace_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

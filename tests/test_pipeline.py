@@ -1,0 +1,249 @@
+"""End-to-end pipeline: file -> search_by_chunks -> candidates -> resume;
+PulseInfo persistence; cleanup writer; CLIs."""
+import os
+
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.io.candidates import CandidateStore, config_fingerprint
+from pulsarutils_tpu.io.sigproc import (
+    FilterbankReader,
+    write_simulated_filterbank,
+)
+from pulsarutils_tpu.models.simulate import (
+    disperse_array,
+    inject_rfi,
+    simulate_test_data,
+)
+from pulsarutils_tpu.pipeline.cleanup import cleanup_data
+from pulsarutils_tpu.pipeline.pulse_info import PulseInfo
+from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+
+@pytest.fixture(scope="module")
+def pulse_file(tmp_path_factory):
+    """A filterbank with one strong dispersed pulse at a known location."""
+    tmp = tmp_path_factory.mktemp("pipeline")
+    rng = np.random.default_rng(0)
+    nchan, nsamples = 64, 16384
+    array = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+    pulse_t = 9000
+    array[:, pulse_t] += 4.0
+    array = disperse_array(array, 150, 1200., 200., 0.0005)
+    sim_header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": 0.0005,
+                  "foff": 200. / nchan}
+    path = str(tmp / "pulse.fil")
+    write_simulated_filterbank(path, array, sim_header, descending=True)
+    return path, pulse_t
+
+
+def test_search_by_chunks_finds_pulse(pulse_file, tmp_path):
+    path, pulse_t = pulse_file
+    hits, store = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax",
+        output_dir=str(tmp_path), make_plots=False, snr_threshold=6.0)
+    assert len(hits) >= 1
+    # the hit chunk contains the pulse and nails the DM
+    assert any(istart <= pulse_t < iend for istart, iend, _, _ in hits)
+    best = max(hits, key=lambda h: h[2].snr)
+    assert np.isclose(best[2].dm, 150, atol=2)
+    # candidate products exist on disk
+    cands = list(store.candidates())
+    assert len(cands) == len(hits)
+    info, table = store.load_candidate(*cands[0])
+    assert info.nchan == 64
+    assert table.nrows > 0
+    # periodicity slots were filled
+    assert info.disp_H is not None
+
+
+def test_search_by_chunks_resume(pulse_file, tmp_path):
+    path, _ = pulse_file
+    kwargs = dict(dmmin=100, dmmax=200, backend="jax",
+                  output_dir=str(tmp_path), make_plots=False)
+    hits1, store1 = search_by_chunks(path, max_chunks=2, **kwargs)
+    done_first = store1.done_chunks
+    assert len(done_first) == 2
+    # second run continues where the first stopped
+    hits2, store2 = search_by_chunks(path, **kwargs)
+    assert set(store2.done_chunks) >= set(done_first)
+    # a fully processed file re-run does nothing new
+    hits3, store3 = search_by_chunks(path, **kwargs)
+    assert store3.done_chunks == store2.done_chunks
+    assert hits3 == []
+
+
+def test_resume_ledger_invalidated_by_config_change(tmp_path):
+    fp_a = config_fingerprint(dmmin=100, dmmax=200)
+    fp_b = config_fingerprint(dmmin=100, dmmax=300)
+    assert fp_a != fp_b
+    store = CandidateStore(str(tmp_path), fp_a)
+    store.mark_done(0)
+    # same config -> remembered
+    assert CandidateStore(str(tmp_path), fp_a).is_done(0)
+    # different config -> forgotten
+    assert not CandidateStore(str(tmp_path), fp_b).is_done(0)
+
+
+def test_search_by_chunks_numpy_backend_parity(pulse_file, tmp_path):
+    path, _ = pulse_file
+    hits_j, _ = search_by_chunks(path, dmmin=100, dmmax=200, backend="jax",
+                                 output_dir=str(tmp_path / "j"),
+                                 make_plots=False)
+    hits_n, _ = search_by_chunks(path, dmmin=100, dmmax=200, backend="numpy",
+                                 output_dir=str(tmp_path / "n"),
+                                 make_plots=False)
+    assert len(hits_j) == len(hits_n)
+    for hj, hn in zip(hits_j, hits_n):
+        assert hj[0] == hn[0]
+        assert np.isclose(hj[2].dm, hn[2].dm, atol=1e-6)
+
+
+def test_pulse_info_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    info = PulseInfo(nbin=128, nchan=8, start_freq=1200., bandwidth=200.,
+                     pulse_freq=2.0, dm=150., snr=9.5,
+                     allprofs=rng.normal(size=(8, 128)),
+                     disp_profile=rng.normal(size=128),
+                     dedisp_profile=np.abs(rng.normal(size=128)))
+    info.compute_stats()
+    assert info.dedisp_z2 is not None and info.dedisp_H is not None
+    path = str(tmp_path / "cand.npz")
+    info.save(path)
+    loaded = PulseInfo.load(path)
+    assert loaded.dm == info.dm
+    assert loaded.nbin == 128
+    assert np.allclose(loaded.allprofs, info.allprofs)
+    assert loaded.dedisp_H == pytest.approx(info.dedisp_H)
+
+
+def test_cleanup_data_writes_clean_file(tmp_path):
+    array, sim_header = simulate_test_data(0, nchan=32, nsamples=4096,
+                                           signal=0.0, rng=2)
+    array += 30.0
+    bad = (4, 20)
+    array = inject_rfi(array, bad_channels=bad, bad_channel_scale=15, rng=3)
+    src = str(tmp_path / "dirty.fil")
+    write_simulated_filterbank(src, array, sim_header)
+    dst = str(tmp_path / "clean.fil")
+    mask = cleanup_data(src, dst)
+    assert set(np.flatnonzero(mask)) >= set(bad)
+    out = FilterbankReader(dst)
+    block = out.read_block(0, out.nsamples)
+    assert not np.any(block[list(bad), :])
+    good = sorted(set(range(32)) - set(bad))
+    assert np.allclose(block[good], array[good], atol=1e-4)
+    # header preserved
+    assert out.header["tsamp"] == sim_header["tsamp"]
+    assert out.header["nchans"] == 32
+
+
+def test_cleanup_data_fft_zap(tmp_path):
+    array, sim_header = simulate_test_data(0, nchan=16, nsamples=4096,
+                                           signal=0.0, rng=4)
+    array += 10.0
+    tone = 3.0 * np.sin(2 * np.pi * np.arange(4096) / 64)
+    array = array + tone[None, :]
+    src = str(tmp_path / "tone.fil")
+    write_simulated_filterbank(src, array, sim_header)
+    dst = str(tmp_path / "tone_clean.fil")
+    cleanup_data(src, dst, fft_zap=True, chunksize=4096)
+    block = FilterbankReader(dst).read_block(0, 4096)
+    k = 4096 // 64
+    power_clean = np.abs(np.fft.rfft(block.mean(0)))[k]
+    power_dirty = np.abs(np.fft.rfft(array.mean(0)))[k]
+    assert power_clean < power_dirty / 50
+
+
+def test_diagnostic_plot_renders(pulse_file, tmp_path):
+    path, _ = pulse_file
+    hits, _ = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax",
+        output_dir=str(tmp_path), make_plots="hits", snr_threshold=6.0)
+    assert len(hits) >= 1
+    jpgs = [f for f in os.listdir(tmp_path) if f.endswith(".jpg")]
+    assert len(jpgs) == len(hits)
+    assert all(os.path.getsize(os.path.join(tmp_path, f)) > 10000
+               for f in jpgs)
+
+
+def test_cli_stats_and_clean(tmp_path, capsys):
+    from pulsarutils_tpu.cli import clean_main, stats_main
+
+    array, sim_header = simulate_test_data(0, nchan=16, nsamples=2048,
+                                           signal=0.0, rng=5)
+    array += 25.0
+    array = inject_rfi(array, bad_channels=(3,), bad_channel_scale=20, rng=6)
+    src = str(tmp_path / "obs.fil")
+    write_simulated_filterbank(src, array, sim_header)
+
+    assert stats_main.main([src, "--plot", str(tmp_path / "bp.png")]) == 0
+    assert os.path.exists(src + ".badchans")
+    assert os.path.exists(str(tmp_path / "bp.png"))
+
+    assert clean_main.main([src, "-o", str(tmp_path / "out.fil")]) == 0
+    block = FilterbankReader(str(tmp_path / "out.fil")).read_block(0, 2048)
+    assert not np.any(block[3])
+
+
+def test_cli_search(pulse_file, tmp_path):
+    from pulsarutils_tpu.cli import search_main
+
+    path, _ = pulse_file
+    rc = search_main.main([
+        path, "--dmmin", "100", "--dmmax", "200",
+        "--output-dir", str(tmp_path), "--plots", "none"])
+    assert rc == 0
+    assert any(f.endswith(".info.npz") for f in os.listdir(tmp_path))
+
+
+def test_no_resume_store_does_not_pollute_ledger(tmp_path):
+    fp = config_fingerprint(x=1)
+    CandidateStore(str(tmp_path), fp).mark_done(0)
+    # a no-resume store records nothing and reports nothing done
+    noresume = CandidateStore(str(tmp_path), None)
+    noresume.mark_done(10000)
+    assert not noresume.is_done(10000)
+    assert CandidateStore(str(tmp_path), fp).done_chunks == [0]
+
+
+def test_per_fingerprint_ledgers_coexist(tmp_path):
+    fp_a = config_fingerprint(f="a")
+    fp_b = config_fingerprint(f="b")
+    CandidateStore(str(tmp_path), fp_a).mark_done(1)
+    CandidateStore(str(tmp_path), fp_b).mark_done(2)
+    assert CandidateStore(str(tmp_path), fp_a).done_chunks == [1]
+    assert CandidateStore(str(tmp_path), fp_b).done_chunks == [2]
+
+
+def test_surelybad_invalidates_resume(pulse_file, tmp_path):
+    path, _ = pulse_file
+    kwargs = dict(dmmin=100, dmmax=200, backend="jax",
+                  output_dir=str(tmp_path), make_plots=False, max_chunks=1)
+    _, store1 = search_by_chunks(path, **kwargs)
+    assert len(store1.done_chunks) == 1
+    # adding a forced-bad channel must NOT reuse the old ledger
+    _, store2 = search_by_chunks(path, surelybad=(3,), **kwargs)
+    assert store1.fingerprint != store2.fingerprint
+    assert len(store2.done_chunks) == 1
+
+
+def test_multi_dot_filenames_keep_distinct_roots(tmp_path):
+    rng = np.random.default_rng(9)
+    arrays = {}
+    for day in ("day1", "day2"):
+        array = np.abs(rng.normal(0, 0.5, (32, 4096))) + 10.0
+        array[:, 2000] += 5.0
+        array = disperse_array(array, 150, 1200., 200., 0.0005)
+        sim_h = {"bandwidth": 200., "fbottom": 1200., "nchans": 32,
+                 "nsamples": 4096, "tsamp": 0.0005, "foff": 200. / 32}
+        path = str(tmp_path / f"obs.{day}.fil")
+        write_simulated_filterbank(path, array, sim_h)
+        arrays[day] = path
+    out = str(tmp_path / "out")
+    for path in arrays.values():
+        search_by_chunks(path, dmmin=100, dmmax=200, output_dir=out,
+                         make_plots=False)
+    roots = {r for r, _, _ in CandidateStore(out).candidates()}
+    assert roots == {"obs.day1", "obs.day2"}
