@@ -44,7 +44,15 @@ from .ops.clean_ops import (
 )
 from .ops.dedisperse import dedisperse, roll_and_sum, apply_dm_shifts_to_data
 from .ops.search import dedispersion_search
-from .models.simulate import simulate_test_data
+from .ops.periodicity import (
+    epoch_folding_search,
+    fold,
+    harmonic_sum,
+    period_search_plane,
+    power_spectrum,
+    spectral_search,
+)
+from .models.simulate import simulate_pulsar_data, simulate_test_data
 from .utils.table import ResultTable
 
 
@@ -100,6 +108,13 @@ __all__ = [
     "roll_and_sum",
     "apply_dm_shifts_to_data",
     "dedispersion_search",
+    "power_spectrum",
+    "harmonic_sum",
+    "spectral_search",
+    "fold",
+    "epoch_folding_search",
+    "period_search_plane",
     "simulate_test_data",
+    "simulate_pulsar_data",
     "ResultTable",
 ]

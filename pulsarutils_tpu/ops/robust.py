@@ -128,17 +128,28 @@ def h_test(profile, nmax=20, xp=np):
     return h_candidates[best], best + 1
 
 
-def h_test_batch(profiles, nmax=20, xp=np):
+def h_test_batch(profiles, nmax=20, xp=np, total=None):
     """Vectorised H-test over a batch of profiles ``(nprof, nbin)``.
 
     Returns ``(H, m_best)`` arrays of shape ``(nprof,)``.  This is what the
     diagnostics use to score the whole dedispersed plane in one shot instead
     of the reference's per-row Python loop (``clean.py:253``).
+
+    ``total`` overrides the ``2 / total`` normalising denominator.  The
+    default (per-profile sum) is the Poisson/event-count convention; for
+    profiles folded from *Gaussian* data pass ``total = T * sigma**2``
+    (samples times per-sample variance) — then the Fourier powers have
+    variance ``T sigma^2 / 2`` per component and ``Z^2_m ~ chi^2_{2m}``
+    under the null, keeping H chi-square calibrated instead of scaling
+    with the noise amplitude.
     """
     profiles = xp.asarray(profiles, dtype=float)
     nbin = profiles.shape[1]
     nmax = int(max(1, min(nmax, nbin // 2 if nbin >= 4 else 1)))
-    total = profiles.sum(axis=1, keepdims=True)
+    if total is None:
+        total = profiles.sum(axis=1, keepdims=True)
+    else:
+        total = xp.reshape(xp.asarray(total, dtype=float), (-1, 1))
     spec = xp.fft.rfft(profiles, axis=1)
     powers = xp.abs(spec[:, 1:nmax + 1]) ** 2
     z2 = 2.0 / total * xp.cumsum(powers, axis=1)
