@@ -40,6 +40,11 @@ def build_parser():
     parser.add_argument("--no-resume", action="store_true",
                         help="reprocess chunks already in the ledger")
     parser.add_argument("--max-chunks", type=int, default=None)
+    parser.add_argument("--period-search", action="store_true",
+                        help="also run the folded period search on each "
+                             "chunk's dedispersed plane")
+    parser.add_argument("--period-sigma", type=float, default=8.0,
+                        help="significance threshold for periodic hits")
     return parser
 
 
@@ -63,6 +68,8 @@ def main(args=None):
             fft_zap=opts.fft_zap,
             cut_outliers=opts.cut_outliers,
             max_chunks=opts.max_chunks,
+            period_search=opts.period_search,
+            period_sigma_threshold=opts.period_sigma,
         )
         total_hits += len(hits)
     logger.info("total candidates: %d", total_hits)

@@ -183,16 +183,73 @@ def _jax_search_kernel(capture_plane, chan_block):
     return kernel
 
 
-def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
-                capture_plane, dm_block, chan_block, dtype):
+#: trials dedispersed per Pallas pass — bounds the live plane to
+#: superblock * nsamples floats (512 x 1M = 2 GB) regardless of ndm
+PALLAS_SUPERBLOCK = 512
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_scorer():
+    import jax
     import jax.numpy as jnp
 
-    dtype = dtype or jnp.float32
-    data = jnp.asarray(data, dtype=dtype)
-    nchan, nsamples = data.shape
+    @jax.jit
+    def score(plane):
+        return score_profiles(plane, xp=jnp)
+
+    return score
+
+
+def _search_jax_pallas(data, offsets, capture_plane, dm_block=None,
+                       chan_block=None):
+    """Pallas-kernel sweep: dedisperse in trial superblocks, score each."""
+    from .pallas_dedisperse import dedisperse_plane_pallas
+
+    ndm = offsets.shape[0]
+    scorer = _jitted_scorer()
+    outs, planes = [], []
+    for lo in range(0, ndm, PALLAS_SUPERBLOCK):
+        sub = offsets[lo:lo + PALLAS_SUPERBLOCK]
+        plane = dedisperse_plane_pallas(data, sub,
+                                        dm_block=dm_block or 64,
+                                        chan_block=chan_block or 8)
+        outs.append([np.asarray(o) for o in scorer(plane)])
+        if capture_plane:
+            planes.append(np.asarray(plane))
+    maxvalues, stds, best_snrs, best_windows = (
+        np.concatenate([o[i] for o in outs]) for i in range(4))
+    plane = np.concatenate(planes) if capture_plane else None
+    return maxvalues, stds, best_snrs, best_windows, plane
+
+
+def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
+                capture_plane, dm_block, chan_block, dtype, kernel="auto"):
+    import jax
+    import jax.numpy as jnp
+
+    nchan, nsamples = np.shape(data)
     ndm = len(trial_dms)
     offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
                            sample_time, nsamples)
+
+    if kernel == "auto":
+        # the hand-written Pallas kernel is the fast path on TPU; the XLA
+        # batched gather is the portable fallback (and the CPU-test path —
+        # interpret-mode Pallas is far too slow at real sizes).  The Pallas
+        # kernel is float32-only: an explicit non-f32 dtype falls back.
+        use_pallas = (jax.default_backend() == "tpu"
+                      and dtype in (None, jnp.float32))
+        kernel = "pallas" if use_pallas else "gather"
+    if kernel == "pallas":
+        if dtype not in (None, jnp.float32):
+            raise ValueError("kernel='pallas' supports float32 only; use "
+                             "kernel='gather' for other dtypes")
+        data = jnp.asarray(data, dtype=jnp.float32)
+        return _search_jax_pallas(data, offsets, capture_plane, dm_block,
+                                  chan_block)
+
+    dtype = dtype or jnp.float32
+    data = jnp.asarray(data, dtype=dtype)
 
     if dm_block is None:
         dm_block = max(1, min(ndm, 32))
@@ -200,8 +257,8 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
         chan_block = auto_chan_block(nchan, nsamples, dm_block)
     offset_blocks = block_offsets(offsets, dm_block)
 
-    kernel = _jax_search_kernel(capture_plane, chan_block)
-    out = kernel(data, jnp.asarray(offset_blocks))
+    gather_kernel = _jax_search_kernel(capture_plane, chan_block)
+    out = gather_kernel(data, jnp.asarray(offset_blocks))
     out = [np.asarray(o).reshape(-1, *o.shape[2:])[:ndm] for o in out]
     if capture_plane:
         maxvalues, stds, best_snrs, best_windows, plane = out
@@ -218,7 +275,7 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                         show=False, *, backend="numpy", capture_plane=None,
                         trial_dms=None, dm_block=None, chan_block=None,
-                        dtype=None):
+                        dtype=None, kernel="auto"):
     """Sweep trial DMs over ``data`` and score each dedispersed series.
 
     Parameters mirror the reference façade
@@ -235,6 +292,10 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         (one trial per integer sample of band-crossing delay).
     dm_block, chan_block : JAX blocking factors (memory/speed trade-off).
     dtype : device dtype for the JAX path (default float32).
+    kernel : JAX-path kernel selector: ``"auto"`` (Pallas on TPU, gather
+        elsewhere), ``"pallas"`` (hand-written tiled TPU kernel, see
+        :mod:`.pallas_dedisperse`) or ``"gather"`` (portable XLA
+        ``take_along_axis`` formulation).
 
     Returns
     -------
@@ -256,7 +317,7 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     elif backend == "jax":
         maxvalues, stds, best_snrs, best_windows, plane = _search_jax(
             data, trial_dms, start_freq, bandwidth, sample_time, capture_plane,
-            dm_block, chan_block, dtype)
+            dm_block, chan_block, dtype, kernel)
     else:
         raise ValueError(f"unknown backend {backend!r}")
 

@@ -21,7 +21,8 @@ import numpy as np
 
 from ..ops.robust import digitize, h_test, z_n_test
 
-_ARRAY_FIELDS = ("allprofs", "dedisp_profile", "disp_profile")
+_ARRAY_FIELDS = ("allprofs", "dedisp_profile", "disp_profile",
+                 "fold_profile")
 
 
 @dataclasses.dataclass
@@ -48,6 +49,14 @@ class PulseInfo:
     allprofs: np.ndarray | None = None        # (nchan, nbin) chunk waterfall
     disp_profile: np.ndarray | None = None    # band-averaged, dispersed
     dedisp_profile: np.ndarray | None = None  # band-averaged, dedispersed
+
+    # folded-period-search candidate (ops.periodicity stage)
+    period_freq: float | None = None   # candidate spin frequency (Hz)
+    period_dm: float | None = None     # DM of the plane row it was found in
+    period_sigma: float | None = None  # Gaussian-equivalent significance
+    period_H: float | None = None      # refined H statistic
+    period_M: int | None = None        # best harmonic count of the H-test
+    fold_profile: np.ndarray | None = None  # folded pulse profile (nbin,)
 
     # periodicity statistics (reference clean.py:43-55 slots)
     disp_z2: float | None = None

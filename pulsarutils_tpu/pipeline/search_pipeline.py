@@ -39,12 +39,20 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      dmmin=200, dmmax=800, surelybad=(), *, backend="jax",
                      snr_threshold=6.0, output_dir=None, make_plots="hits",
                      resume=True, fft_zap=False, cut_outliers=False,
-                     max_chunks=None, progress=True):
+                     max_chunks=None, progress=True, period_search=False,
+                     period_sigma_threshold=8.0):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
     TPU-framework knobs (keyword-only).  ``make_plots``: ``"hits"``
     (diagnostic JPEG per candidate), ``"all"``, or ``False``.
+
+    ``period_search=True`` adds the folded period search
+    (:func:`..ops.periodicity.period_search_plane`) on every chunk's
+    dedispersed plane: a chunk whose best periodic candidate exceeds
+    ``period_sigma_threshold`` is persisted as a hit even without a
+    single-pulse detection, with the folded profile and H statistics on
+    its :class:`~.pulse_info.PulseInfo`.
 
     Returns ``(hits, store)`` where hits is a list of
     ``(istart, iend, PulseInfo, ResultTable)``.
@@ -93,12 +101,14 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         fname=os.path.abspath(str(fname)), dmmin=dmmin, dmmax=dmmax,
         step=plan.step, resample=plan.resample, backend=backend,
         snr_threshold=snr_threshold, fft_zap=fft_zap,
-        cut_outliers=cut_outliers, surelybad=sorted(int(c) for c in surelybad))
+        cut_outliers=cut_outliers, surelybad=sorted(int(c) for c in surelybad),
+        period_search=bool(period_search),
+        period_sigma_threshold=float(period_sigma_threshold))
     store = CandidateStore(output_dir, fingerprint if resume else None)
 
     hits = []
     nproc = 0
-    capture = bool(make_plots)
+    capture = bool(make_plots) or bool(period_search)
     for istart in iter_chunk_starts(nsamples, plan, tmin=tmin,
                                     sample_time=sample_time):
         if max_chunks is not None and nproc >= max_chunks:
@@ -132,6 +142,32 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
 
         best = table.best_row()
         is_hit = bool(best["snr"] > snr_threshold)
+
+        if period_search and plane is not None:
+            from ..ops.periodicity import period_search_plane
+
+            if backend == "jax":
+                import jax.numpy as _xp
+            else:
+                _xp = np
+            with with_timer("period"):
+                pres = period_search_plane(
+                    _xp.asarray(plane), eff_tsamp,
+                    fmin=4.0 / (plane.shape[1] * eff_tsamp), refine_top=1,
+                    xp=_xp)
+            if pres["best_sigma"] > period_sigma_threshold:
+                info.period_freq = float(pres["best_freq"])
+                info.period_dm = float(table["DM"][pres["best_dm_index"]])
+                info.period_sigma = float(pres["best_sigma"])
+                info.period_H = float(pres["best_h"])
+                info.period_M = int(pres["best_m"])
+                if pres["best_profile"] is not None:
+                    info.fold_profile = np.asarray(pres["best_profile"])
+                is_hit = True
+                logger.info("PERIODIC chunk %d-%d: f=%.4f Hz DM=%.2f "
+                            "sigma=%.1f", istart, iend, info.period_freq,
+                            info.period_dm, info.period_sigma)
+
         if is_hit:
             info.dm = float(best["DM"])
             info.snr = float(best["snr"])

@@ -247,3 +247,44 @@ def test_multi_dot_filenames_keep_distinct_roots(tmp_path):
                          make_plots=False)
     roots = {r for r, _, _ in CandidateStore(out).candidates()}
     assert roots == {"obs.day1", "obs.day2"}
+
+
+@pytest.fixture(scope="module")
+def pulsar_file(tmp_path_factory):
+    """A filterbank with a dispersed periodic pulsar (no single pulse
+    bright enough to trip the S/N threshold on its own)."""
+    from pulsarutils_tpu.models.simulate import simulate_pulsar_data
+
+    tmp = tmp_path_factory.mktemp("pipeline_psr")
+    period, dm = 0.064, 150.0
+    array, header = simulate_pulsar_data(period=period, dm=dm,
+                                         nsamples=16384, nchan=64,
+                                         signal=0.35, noise=0.5, rng=21)
+    array = array + 20.0
+    sim_header = {"bandwidth": 200., "fbottom": 1200., "nchans": 64,
+                  "nsamples": 16384, "tsamp": 0.0005, "foff": 200. / 64}
+    path = str(tmp / "pulsar.fil")
+    write_simulated_filterbank(path, array, sim_header, descending=True)
+    return path, period, dm
+
+
+def test_search_by_chunks_period_search(pulsar_file, tmp_path):
+    path, period, dm = pulsar_file
+    hits, store = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax",
+        output_dir=str(tmp_path), make_plots=False,
+        snr_threshold=1e9,  # single-pulse path disabled: periodic-only hits
+        period_search=True, period_sigma_threshold=6.0)
+    assert len(hits) >= 1
+    info = hits[0][2]
+    assert info.period_freq is not None
+    ratio = info.period_freq * period
+    assert abs(ratio - round(ratio)) < 0.06 and 1 <= round(ratio) <= 16
+    assert abs(info.period_dm - dm) < 20
+    assert info.period_sigma > 6.0
+    assert info.fold_profile is not None
+    # round-trips through the candidate store
+    cands = list(store.candidates())
+    loaded, _ = store.load_candidate(*cands[0])
+    assert loaded.period_freq == pytest.approx(info.period_freq)
+    assert loaded.fold_profile is not None
