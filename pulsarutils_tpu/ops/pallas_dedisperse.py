@@ -135,36 +135,24 @@ def _pick_t_tile(max_off, nsamples):
     return min(t_tile, max(256, 1 << int(np.floor(np.log2(max(nsamples, 256))))))
 
 
-def dedisperse_plane_pallas(data, offsets, dm_block=64, chan_block=8,
-                            t_tile=None, interpret=None):
-    """Dedispersed plane ``out[d, t] = sum_c data[c, (t + off[d,c]) % T]``.
+def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=64,
+                                   chan_block=8, t_tile=None, interpret=None):
+    """Trace-friendly core of :func:`dedisperse_plane_pallas`.
 
-    Parameters
-    ----------
-    data : (nchan, T) float32 array (device or host)
-    offsets : (ndm, nchan) int32 gather offsets — the per-channel DM delays
-        in samples, wrapped into ``[0, T)`` (same convention as
-        :func:`~pulsarutils_tpu.ops.dedisperse.dedisperse_block_jax`).
-    dm_block, chan_block : kernel blocking (trials per output block,
-        channels accumulated per grid step).
-    t_tile : time-tile length; default picked from the maximum offset.
-    interpret : run in the Pallas interpreter.  Default (``None``) auto:
-        compiled on TPU, interpreted elsewhere (CPU testing).
-
-    Returns
-    -------
-    (ndm, T) float32 device array.
+    ``data`` and ``offsets`` may be traced jax arrays (e.g. shards inside a
+    ``shard_map``); ``max_off`` must be a *static* host int bounding every
+    offset (it sets the halo tile count, which is a compile-time property).
     """
     jax, jnp, pl, pltpu = _pallas_modules()
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     data = jnp.asarray(data, dtype=jnp.float32)
-    offsets = np.asarray(offsets, dtype=np.int32)
+    offsets = jnp.asarray(offsets, dtype=jnp.int32)
     nchan, t = data.shape
     ndm = offsets.shape[0]
 
-    max_off = int(offsets.max(initial=0))
+    max_off = int(max_off)
     if t_tile is None:
         t_tile = _pick_t_tile(max_off, t)
     t_tile = int(min(t_tile, t))
@@ -176,25 +164,55 @@ def dedisperse_plane_pallas(data, offsets, dm_block=64, chan_block=8,
     # pad trials (duplicate last), channels (zeros), time (circular wrap)
     ndm_p = -(-ndm // dm_block) * dm_block
     if ndm_p != ndm:
-        offsets = np.concatenate(
-            [offsets, offsets[-1:].repeat(ndm_p - ndm, axis=0)])
+        offsets = jnp.concatenate(
+            [offsets, jnp.repeat(offsets[-1:], ndm_p - ndm, axis=0)])
     nchan_p = -(-nchan // chan_block) * chan_block
     if nchan_p != nchan:
         data = jnp.concatenate(
             [data, jnp.zeros((nchan_p - nchan, t), jnp.float32)])
         # padded channels read window start 0; they contribute zeros anyway
-        offsets = np.concatenate(
-            [offsets, np.zeros((ndm_p, nchan_p - nchan), np.int32)], axis=1)
+        offsets = jnp.concatenate(
+            [offsets, jnp.zeros((ndm_p, nchan_p - nchan), jnp.int32)],
+            axis=1)
 
     n_t = -(-t // t_tile)
     t_out = n_t * t_tile
     text = (n_t + k_tiles - 1) * t_tile
     # circular extension: data_ext[:, i] = data[:, i % t]
-    reps = -(-text // t)
-    data_ext = jnp.concatenate([data] * (reps + 1), axis=1)[:, :text] \
-        if reps > 1 else jnp.concatenate([data, data], axis=1)[:, :text]
+    reps = max(2, -(-text // t) + 1)
+    data_ext = jnp.concatenate([data] * reps, axis=1)[:, :text]
 
     run = _build_kernel(ndm_p, nchan_p, text, t_out, dm_block, chan_block,
                         t_tile, k_tiles, interpret)
-    plane = run(jnp.asarray(offsets), data_ext)
+    plane = run(offsets, data_ext)
     return plane[:ndm, :t]
+
+
+def dedisperse_plane_pallas(data, offsets, dm_block=64, chan_block=8,
+                            t_tile=None, interpret=None):
+    """Dedispersed plane ``out[d, t] = sum_c data[c, (t + off[d,c]) % T]``.
+
+    Parameters
+    ----------
+    data : (nchan, T) float32 array (device or host)
+    offsets : (ndm, nchan) int32 gather offsets — the per-channel DM delays
+        in samples, wrapped into ``[0, T)`` (same convention as
+        :func:`~pulsarutils_tpu.ops.dedisperse.dedisperse_block_jax`).
+        Must be concrete (host) values; inside traced code use
+        :func:`dedisperse_plane_pallas_traced` with a static ``max_off``.
+    dm_block, chan_block : kernel blocking (trials per output block,
+        channels accumulated per grid step).
+    t_tile : time-tile length; default picked from the maximum offset.
+    interpret : run in the Pallas interpreter.  Default (``None``) auto:
+        compiled on TPU, interpreted elsewhere (CPU testing).
+
+    Returns
+    -------
+    (ndm, T) float32 device array.
+    """
+    offsets = np.asarray(offsets, dtype=np.int32)
+    max_off = int(offsets.max(initial=0))
+    return dedisperse_plane_pallas_traced(data, offsets, max_off,
+                                          dm_block=dm_block,
+                                          chan_block=chan_block,
+                                          t_tile=t_tile, interpret=interpret)

@@ -175,3 +175,21 @@ def test_stream_search_finds_pulse_in_right_chunk():
     # at least one hit (the chunk fully containing the pulse) nails the DM;
     # overlapping neighbours see a wrapped pulse and may be slightly off
     assert any(np.isclose(best["DM"], 150, atol=2) for _, _, best in hits)
+
+
+def test_sharded_search_pallas_kernel_matches_numpy():
+    """Per-shard Pallas kernel inside shard_map (interpret mode on the
+    virtual CPU mesh) must reproduce the NumPy reference hits."""
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    array, header = simulate_test_data(150, nchan=32, nsamples=1024, rng=3)
+    args = (100, 200., header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    t_ref = dedispersion_search(array, *args, backend="numpy")
+    t_pl = sharded_dedispersion_search(array, *args, mesh=mesh,
+                                       kernel="pallas")
+    assert t_pl.argbest() == t_ref.argbest()
+    np.testing.assert_allclose(np.asarray(t_pl["snr"]),
+                               np.asarray(t_ref["snr"]), rtol=2e-3,
+                               atol=2e-3)
