@@ -56,6 +56,39 @@ from .models.simulate import simulate_pulsar_data, simulate_test_data
 from .utils.table import ResultTable
 
 
+def test(extra_args=None):
+    """Run the framework's test suite and return the pytest exit code.
+
+    Scaffold parity with the reference's astropy-template self-runner
+    (``pulsarutils.test()``, reference ``_astropy_init.py:27-30``).  Runs
+    pytest in a *fresh subprocess* from the source checkout root: the test
+    harness pins JAX to an 8-virtual-device CPU backend, which must not
+    leak into (or be blocked by) the calling process's JAX state.
+
+    ``extra_args`` may be a string (``"-k robust"``) or an iterable of
+    pytest arguments.  Requires a source checkout (the test tree is not
+    installed with the wheel).
+    """
+    import os
+    import shlex
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo_root, "tests")
+    if not os.path.isdir(tests):
+        raise RuntimeError(
+            "pulsarutils_tpu.test() needs a source checkout (tests/ is not "
+            f"shipped in the installed package; looked in {repo_root})")
+    if isinstance(extra_args, str):
+        extra = shlex.split(extra_args)
+    else:
+        extra = list(extra_args) if extra_args else []
+    proc = subprocess.run([sys.executable, "-m", "pytest", tests, "-q"]
+                          + extra, cwd=repo_root)
+    return int(proc.returncode)
+
+
 def __getattr__(name):
     """Lazy re-exports of the pipeline/IO layer (keeps bare ``import
     pulsarutils_tpu`` light — no matplotlib / file machinery)."""
