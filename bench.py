@@ -65,9 +65,15 @@ def measure_jax(array, trial_dms, geom, kernel):
     t0 = time.time()
     table = run()
     log(f"first run (incl. compile): {time.time() - t0:.2f}s")
-    t0 = time.time()
-    table = run()
-    jax_time = time.time() - t0
+    from pulsarutils_tpu.utils.logging_utils import device_trace
+
+    trace_dir = os.environ.get("BENCH_TRACE")
+    with device_trace(trace_dir):  # no-op when BENCH_TRACE unset
+        t0 = time.time()
+        table = run()
+        jax_time = time.time() - t0
+    if trace_dir:
+        log(f"profiler trace written to {trace_dir}")
     return table, len(trial_dms) / jax_time, jax_time
 
 

@@ -1,0 +1,210 @@
+"""Benchmark suite over the five BASELINE.json configurations.
+
+Each config prints one JSON line (same schema as bench.py where a
+baseline comparison exists). Select with --configs 1 2 3 4 5 (default:
+all). Failures in one config don't stop the others.
+
+  1  256-chan x 65k, 64 trials — single-core NumPy (reference semantics)
+  2  1024-chan x 1M, 512 trials — jax kernel, one chip (== bench.py)
+  3  RFI-contaminated 1024-chan stream -> FFT mask -> dedisperse
+  4  4096 DM trials + folded period search (FFT over dedispersed plane)
+  5  streaming 8 x 1M-sample chunks, on-device running stats + overlap
+
+Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+GEOM = (1200.0, 200.0, 0.0005)  # start_freq, bandwidth, tsamp
+
+
+def simulate(nchan, nsamp, dm=350.0, seed=0):
+    from pulsarutils_tpu.ops.plan import dedispersion_shifts
+
+    rng = np.random.default_rng(seed)
+    array = np.abs(rng.standard_normal((nchan, nsamp), dtype=np.float32)) * 0.5
+    array[:, nsamp // 2] += 1.0
+    shifts = np.rint(np.asarray(dedispersion_shifts(
+        nchan, dm, *GEOM))).astype(int) % nsamp
+    for c in range(nchan):
+        array[c] = np.roll(array[c], shifts[c])
+    return array
+
+
+def timed(fn, n=2):
+    fn()
+    t0 = time.time()
+    for _ in range(n):
+        out = fn()
+    return out, (time.time() - t0) / n
+
+
+def config1(quick):
+    """Reference-semantics NumPy sweep (the PR1 baseline row)."""
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    nchan, nsamp, ndm = (256, 1 << 16, 64) if not quick else (64, 1 << 13, 16)
+    array = simulate(nchan, nsamp)
+    dms = np.linspace(300., 400., ndm)
+
+    def run():
+        return dedispersion_search(array, None, None, *GEOM,
+                                   backend="numpy", trial_dms=dms)
+
+    table, dt = timed(run, n=1)
+    emit({"config": 1, "metric": f"NumPy reference sweep {nchan}x{nsamp}, "
+          f"{ndm} trials", "value": round(ndm / dt, 3),
+          "unit": "DM-trials/sec",
+          "best_dm": float(table["DM"][table.argbest()])})
+
+
+def config2(quick):
+    """Headline single-chip jax sweep — defer to bench.py's main()."""
+    import bench
+
+    bench.main()
+
+
+def config3(quick):
+    """RFI-contaminated stream -> FFT zap + renormalise -> sweep."""
+    import jax
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.models.simulate import inject_rfi
+    from pulsarutils_tpu.ops.clean_ops import fft_zap_time, renormalize_data
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    nchan, nsamp, ndm = (1024, 1 << 18, 256) if not quick else (128, 1 << 14, 32)
+    array = simulate(nchan, nsamp)
+    array = inject_rfi(array, bad_channels=range(0, nchan, 97),
+                       impulse_times=range(1000, nsamp, nsamp // 7),
+                       rng=1).astype(np.float32)
+    dms = np.linspace(300., 400., ndm)
+
+    clean = jax.jit(lambda a: fft_zap_time(
+        renormalize_data(a, xp=jnp), xp=jnp)[0])
+
+    def run():
+        cleaned = clean(jnp.asarray(array))
+        return dedispersion_search(cleaned, None, None, *GEOM, backend="jax",
+                                   trial_dms=dms)
+
+    table, dt = timed(run)
+    emit({"config": 3, "metric": f"clean(FFT zap + renorm) + sweep "
+          f"{nchan}x{nsamp}, {ndm} trials", "value": round(ndm / dt, 2),
+          "unit": "DM-trials/sec (incl. cleaning)",
+          "best_dm": float(table["DM"][table.argbest()])})
+
+
+def config4(quick):
+    """4096-trial tiled sweep + folded period search over the plane."""
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.models.simulate import simulate_pulsar_data
+    from pulsarutils_tpu.ops.periodicity import period_search_plane
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    nchan, nsamp, ndm = (1024, 1 << 18, 4096) if not quick else (64, 1 << 14, 128)
+    period = 0.0625
+    array, header = simulate_pulsar_data(
+        period=period, dm=350.0, tsamp=GEOM[2], nsamples=nsamp, nchan=nchan,
+        start_freq=GEOM[0], bandwidth=GEOM[1], signal=0.5, noise=0.5, rng=2)
+    array = array.astype(np.float32)
+    dms = np.linspace(300., 400., ndm)
+
+    def run():
+        table, plane = dedispersion_search(
+            array, None, None, *GEOM, backend="jax", trial_dms=dms,
+            capture_plane=True)
+        res = period_search_plane(jnp.asarray(plane), GEOM[2], fmin=2.0,
+                                  refine_top=1, xp=jnp)
+        return table, res
+
+    (table, res), dt = timed(run, n=1)
+    ratio = res["best_freq"] * period
+    emit({"config": 4, "metric": f"{ndm}-trial sweep + folded period search, "
+          f"{nchan}x{nsamp}", "value": round(ndm / dt, 2),
+          "unit": "DM-trials/sec (incl. period search)",
+          "best_freq": float(res["best_freq"]),
+          "freq_harmonic_of_true": round(float(ratio), 3),
+          "period_sigma": round(float(res["best_sigma"]), 1)})
+
+
+def config5(quick):
+    """Streaming chunks: on-device running bandpass stats + overlap search."""
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.ops.search import dedispersion_search
+    from pulsarutils_tpu.pipeline.spectral_stats import (
+        moment_accumulate,
+        moments_to_spectra,
+    )
+
+    nchan = 1024 if not quick else 128
+    chunk = (1 << 20) if not quick else (1 << 14)
+    nchunks = 8 if not quick else 3
+    ndm = 256 if not quick else 32
+    dms = np.linspace(300., 400., ndm)
+    hop = chunk // 2
+    total = hop * (nchunks - 1) + chunk
+    array = simulate(nchan, total)
+
+    def run():
+        s = jnp.zeros(nchan)
+        sq = jnp.zeros(nchan)
+        n = 0
+        best = None
+        for k in range(nchunks):
+            block = jnp.asarray(array[:, k * hop:k * hop + chunk])
+            s, sq, n = moment_accumulate((s, sq, n), block)
+            table = dedispersion_search(block, None, None, *GEOM,
+                                        backend="jax", trial_dms=dms)
+            row = table.best_row()
+            if best is None or row["snr"] > best["snr"]:
+                best = row
+        mean, std = moments_to_spectra(s, sq, n, xp=jnp)
+        return best, float(mean.mean())
+
+    (best, _), dt = timed(run, n=1)
+    samples_per_sec = nchunks * chunk / dt
+    emit({"config": 5, "metric": f"streaming {nchunks} x {chunk}-sample "
+          f"chunks (50% overlap), {nchan} chan, {ndm} trials + running "
+          "stats", "value": round(samples_per_sec / 1e6, 2),
+          "unit": "Msamples/sec", "best_dm": float(best["DM"]),
+          "dm_trials_per_sec": round(nchunks * ndm / dt, 1)})
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--configs", type=int, nargs="*",
+                        default=[1, 2, 3, 4, 5])
+    opts = parser.parse_args(argv)
+    quick = os.environ.get("BENCH_PRESET") == "quick"
+    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+    for c in opts.configs:
+        log(f"=== config {c} ===")
+        try:
+            fns[c](quick)
+        except Exception as exc:
+            traceback.print_exc()
+            emit({"config": c, "error": f"{type(exc).__name__}: {exc}"})
+
+
+if __name__ == "__main__":
+    main()

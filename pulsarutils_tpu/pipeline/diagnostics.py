@@ -127,8 +127,20 @@ def plot_diagnostics(info, table, plane, outname="info.jpg", t0=0.0,
             f"Freq: {info.start_freq}--{info.start_freq + info.bandwidth}\n"
             f"Best DM: {dm:.2f}\n"
             f"Best SNR: {snr:.2f}")
+    if getattr(info, "period_freq", None):
+        text += (f"\nPeriod: {1.0 / info.period_freq * 1e3:.3f} ms "
+                 f"({info.period_sigma:.1f}σ)")
     ax_snr.text(0.5, 0.5, text, va="center", ha="center", fontsize=7,
                 transform=ax_snr.transAxes)
+
+    if getattr(info, "fold_profile", None) is not None:
+        # folded-pulse inset (two cycles) for periodic candidates
+        ax_fold = ax_h.inset_axes([0.45, 0.62, 0.5, 0.33])
+        prof = np.asarray(info.fold_profile, dtype=float)
+        cyc = np.concatenate([prof, prof])
+        ax_fold.plot(np.arange(cyc.size) / prof.size, cyc, lw=0.8)
+        ax_fold.set_xticks([]), ax_fold.set_yticks([])
+        ax_fold.set_title("folded", fontsize=6, pad=1)
 
     fig.savefig(outname, bbox_inches="tight")
     if show:
