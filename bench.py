@@ -32,12 +32,12 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_data(nchan, nsamp, start_freq, bandwidth, tsamp, inject_dm):
+def make_data(nchan, nsamp, start_freq, bandwidth, tsamp, inject_dm, seed=0):
     import numpy as np
 
     from pulsarutils_tpu.ops.plan import dedispersion_shifts
 
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     log(f"simulating {nchan} x {nsamp} filterbank ...")
     array = np.abs(rng.standard_normal((nchan, nsamp), dtype=np.float32)) * 0.5
     array[:, nsamp // 2] += 1.0

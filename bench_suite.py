@@ -35,16 +35,10 @@ GEOM = (1200.0, 200.0, 0.0005)  # start_freq, bandwidth, tsamp
 
 
 def simulate(nchan, nsamp, dm=350.0, seed=0):
-    from pulsarutils_tpu.ops.plan import dedispersion_shifts
+    # single source of truth for the benchmark's injected-signal model
+    import bench
 
-    rng = np.random.default_rng(seed)
-    array = np.abs(rng.standard_normal((nchan, nsamp), dtype=np.float32)) * 0.5
-    array[:, nsamp // 2] += 1.0
-    shifts = np.rint(np.asarray(dedispersion_shifts(
-        nchan, dm, *GEOM))).astype(int) % nsamp
-    for c in range(nchan):
-        array[c] = np.roll(array[c], shifts[c])
-    return array
+    return bench.make_data(nchan, nsamp, *GEOM, dm, seed=seed)
 
 
 def timed(fn, n=2):

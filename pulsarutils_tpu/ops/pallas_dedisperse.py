@@ -52,7 +52,7 @@ def _pallas_modules():
 
 
 def _kernel_body(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
-                 jnp, pl):
+                 jnp, pl, pltpu):
     """out[d, :] += sum_c window[c, off[d, c] : off[d, c] + t_tile]."""
     import jax
 
@@ -70,12 +70,22 @@ def _kernel_body(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
     for k in range(k_tiles):
         win_ref[:, k * t_tile:(k + 1) * t_tile] = data_refs[k][:]
 
+    # Mosaic vector loads need lane starts provably 128-aligned, so the
+    # unaligned shifted read is an aligned (t_tile + 128)-lane load plus a
+    # dynamic sub-128 left-rotate (tpu.DynamicRotateOp via pltpu.roll)
     def body(d, carry):
-        acc = out_ref[d, :]
+        acc = out_ref[pl.ds(d, 1), :]
         for c in range(chan_block):
-            start = off_ref[d, c]
-            acc = acc + win_ref[c, pl.ds(start, t_tile)]
-        out_ref[d, :] = acc
+            start = off_ref[0, 0, d, c]
+            aligned = pl.multiple_of((start // 128) * 128, 128)
+            win = win_ref[pl.ds(c, 1), pl.ds(aligned, t_tile + 128)]
+            # left-rotate by r = start - aligned, expressed as a
+            # non-negative right-rotate — tpu.DynamicRotateOp mishandles
+            # negative dynamic shifts (interpret mode accepts them)
+            rolled = pltpu.roll(win, (t_tile + 128 - (start - aligned))
+                                % (t_tile + 128), 1)
+            acc = acc + rolled[:, :t_tile]
+        out_ref[pl.ds(d, 1), :] = acc
         return carry
 
     jax.lax.fori_loop(0, dm_block, body, 0)
@@ -89,24 +99,34 @@ def _build_kernel(ndm_p, nchan_p, t_ext, t_out, dm_block, chan_block,
     n_dm = ndm_p // dm_block
     n_t = t_out // t_tile
     n_chan = nchan_p // chan_block
+    # number of time tiles in the source array; when it equals n_t (no
+    # extension) the staggered reads wrap tile-modulo, which IS the exact
+    # circular wrap because t_tile divides the array length
+    n_src = t_ext // t_tile
 
-    # the same extended array is passed K times at staggered tile indices,
-    # giving the kernel a (chan_block, K * t_tile) contiguous window
+    # the same (extended) array is passed K times at staggered tile
+    # indices, giving the kernel a (chan_block, K * t_tile) contiguous
+    # window
     data_specs = [
         pl.BlockSpec((chan_block, t_tile),
                      functools.partial(lambda i_d, i_t, i_c, _k:
-                                       (i_c, i_t + _k), _k=k))
+                                       (i_c, (i_t + _k) % n_src), _k=k))
         for k in range(k_tiles)
     ]
-    off_spec = pl.BlockSpec((dm_block, chan_block),
-                            lambda i_d, i_t, i_c: (i_d, i_c),
+    # Mosaic requires the last two block dims to be (8, 128)-divisible OR
+    # equal to the array dims; a raw (dm_block, chan_block) window over the
+    # (ndm, nchan) table satisfies neither, so the offsets arrive pre-tiled
+    # as (n_dm, n_chan, dm_block, chan_block) and each grid step takes one
+    # whole (dm_block, chan_block) tile — trailing dims == array dims.
+    off_spec = pl.BlockSpec((1, 1, dm_block, chan_block),
+                            lambda i_d, i_t, i_c: (i_d, i_c, 0, 0),
                             memory_space=pltpu.SMEM)
     out_spec = pl.BlockSpec((dm_block, t_tile),
                             lambda i_d, i_t, i_c: (i_d, i_t))
 
     kernel = functools.partial(_kernel_body, dm_block=dm_block,
                                chan_block=chan_block, t_tile=t_tile,
-                               k_tiles=k_tiles, jnp=jnp, pl=pl)
+                               k_tiles=k_tiles, jnp=jnp, pl=pl, pltpu=pltpu)
 
     call = pl.pallas_call(
         kernel,
@@ -135,13 +155,37 @@ def _pick_t_tile(max_off, nsamples):
     return min(t_tile, max(256, 1 << int(np.floor(np.log2(max(nsamples, 256))))))
 
 
+def rebase_offsets(offsets, nsamples):
+    """Host-side offset rebase: wrapped ``[0, T)`` offsets -> small
+    non-negative offsets plus a static rotation constant.
+
+    ``normalize_shifts`` wraps negative (above-band-centre) shifts to values
+    near ``T``, which would force the kernel's halo to span the whole array.
+    Mapping back to signed form and subtracting the (128-aligned) minimum
+    yields offsets bounded by the band-crossing span instead.  The kernel
+    output is then the reference plane rotated by ``k``; rolling each row by
+    ``-k`` restores it exactly (same floats, same summation order).
+
+    Returns ``(offsets_rebased, k, max_off)`` — all host values.
+    """
+    offsets = np.asarray(offsets, dtype=np.int64)
+    half = nsamples // 2
+    signed = (offsets + half) % nsamples - half
+    k = 128 * int(np.floor(signed.min(initial=0) / 128))
+    rebased = (signed - k).astype(np.int32)
+    return rebased, k, int(rebased.max(initial=0))
+
+
 def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=64,
-                                   chan_block=8, t_tile=None, interpret=None):
+                                   chan_block=8, t_tile=None, interpret=None,
+                                   roll_k=0):
     """Trace-friendly core of :func:`dedisperse_plane_pallas`.
 
     ``data`` and ``offsets`` may be traced jax arrays (e.g. shards inside a
     ``shard_map``); ``max_off`` must be a *static* host int bounding every
     offset (it sets the halo tile count, which is a compile-time property).
+    ``roll_k`` is the static rotation constant from :func:`rebase_offsets`
+    (the returned plane is rolled by ``-roll_k`` to undo the rebase).
     """
     jax, jnp, pl, pltpu = _pallas_modules()
     if interpret is None:
@@ -156,10 +200,20 @@ def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=64,
     if t_tile is None:
         t_tile = _pick_t_tile(max_off, t)
     t_tile = int(min(t_tile, t))
-    k_tiles = max_off // t_tile + 2  # halo tiles covering off + t_tile - 1
 
     dm_block = int(min(dm_block, max(1, ndm)))
     chan_block = int(min(chan_block, nchan))
+    if not interpret:
+        # Mosaic block rule: trailing block dims must be (8, 128)-divisible
+        # or equal to the (padded) array dims.  dm_block/chan_block sit in
+        # the sublane slot of their blocks; t_tile in the lane slot.
+        dm_block = max(8, -(-dm_block // 8) * 8)
+        chan_block = max(8, -(-chan_block // 8) * 8)
+        t_tile = max(128, t_tile - t_tile % 128)
+
+    # halo covering the worst-case aligned load end: the kernel loads
+    # (t_tile + 128) lanes starting at floor(off / 128) * 128 <= max_off
+    k_tiles = (max_off + 128) // t_tile + 2
 
     # pad trials (duplicate last), channels (zeros), time (circular wrap)
     ndm_p = -(-ndm // dm_block) * dm_block
@@ -175,17 +229,37 @@ def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=64,
             [offsets, jnp.zeros((ndm_p, nchan_p - nchan), jnp.int32)],
             axis=1)
 
+    # pre-tile the offsets to the (n_dm, n_chan, dm_block, chan_block)
+    # layout the kernel's SMEM BlockSpec expects (see _build_kernel)
+    offsets = (offsets
+               .reshape(ndm_p // dm_block, dm_block,
+                        nchan_p // chan_block, chan_block)
+               .transpose(0, 2, 1, 3))
+
     n_t = -(-t // t_tile)
     t_out = n_t * t_tile
-    text = (n_t + k_tiles - 1) * t_tile
-    # circular extension: data_ext[:, i] = data[:, i % t]
-    reps = max(2, -(-text // t) + 1)
-    data_ext = jnp.concatenate([data] * reps, axis=1)[:, :text]
+    if t % t_tile == 0:
+        # no extension: the staggered BlockSpec reads wrap tile-modulo,
+        # which is the exact circular wrap when t_tile divides t — zero
+        # extra HBM (the extension copy would double the footprint at the
+        # 4 GB benchmark size)
+        text = t
+        data_ext = data
+    else:
+        # circular extension: data_ext[:, i] = data[:, i % t]
+        text = (n_t + k_tiles - 1) * t_tile
+        if text - t <= t:
+            data_ext = jnp.concatenate([data, data[:, :text - t]], axis=1)
+        else:
+            reps = max(2, -(-text // t) + 1)
+            data_ext = jnp.concatenate([data] * reps, axis=1)[:, :text]
 
     run = _build_kernel(ndm_p, nchan_p, text, t_out, dm_block, chan_block,
                         t_tile, k_tiles, interpret)
-    plane = run(offsets, data_ext)
-    return plane[:ndm, :t]
+    plane = run(offsets, data_ext)[:ndm, :t]
+    if roll_k:
+        plane = jnp.roll(plane, -roll_k, axis=1)
+    return plane
 
 
 def dedisperse_plane_pallas(data, offsets, dm_block=64, chan_block=8,
@@ -210,9 +284,10 @@ def dedisperse_plane_pallas(data, offsets, dm_block=64, chan_block=8,
     -------
     (ndm, T) float32 device array.
     """
-    offsets = np.asarray(offsets, dtype=np.int32)
-    max_off = int(offsets.max(initial=0))
+    nsamples = int(np.shape(data)[1])
+    offsets, roll_k, max_off = rebase_offsets(offsets, nsamples)
     return dedisperse_plane_pallas_traced(data, offsets, max_off,
                                           dm_block=dm_block,
                                           chan_block=chan_block,
-                                          t_tile=t_tile, interpret=interpret)
+                                          t_tile=t_tile, interpret=interpret,
+                                          roll_k=roll_k)

@@ -40,8 +40,8 @@ def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    def local_search(data_local, off_local):
-        # data_local (C_loc, T); off_local (D_loc, C_loc)
+    def local_search(data_local, off_local, roll_k):
+        # data_local (C_loc, T); off_local (D_loc, C_loc); roll_k scalar
         if kernel == "pallas":
             from ..ops.pallas_dedisperse import dedisperse_plane_pallas_traced
 
@@ -51,6 +51,11 @@ def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
             partial = dedisperse_block_chunked_jax(data_local, off_local,
                                                    chan_block)
         dedisp = jax.lax.psum(partial, "chan")
+        if kernel == "pallas":
+            # undo the host-side offset rebase (see rebase_offsets); the
+            # rotation is a traced operand so plans whose rebase constant
+            # differs still share this compiled program
+            dedisp = jnp.roll(dedisp, -roll_k, axis=1)
         scores = score_profiles(dedisp, xp=jnp)
         if capture_plane:
             return scores + (dedisp,)
@@ -62,7 +67,7 @@ def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
     fn = jax.shard_map(
         local_search,
         mesh=mesh,
-        in_specs=(P("chan", None), P("dm", "chan")),
+        in_specs=(P("chan", None), P("dm", "chan"), P()),
         out_specs=out_specs if capture_plane else out_scores,
         # pallas_call outputs carry no varying-mesh-axes metadata, which
         # trips shard_map's vma lint; the collective structure here is a
@@ -118,11 +123,15 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
         kernel = ("pallas" if all(d.platform == "tpu"
                                   for d in mesh.devices.flat)
                   and dtype == jnp.float32 else "gather")
-    # static offset bound for the pallas halo; rounded up to a power of two
-    # so small plan changes reuse the compiled kernel (the gather kernel
-    # does not depend on it — keep its cache key constant)
+    # rebase wrapped offsets to the band-crossing span (see rebase_offsets)
+    # so the pallas halo stays small; max_off is rounded up to a power of
+    # two so small plan changes reuse the compiled kernel (the gather
+    # kernel does not depend on either — keep its cache key constant)
+    roll_k = 0
     if kernel == "pallas":
-        max_off = int(offsets.max(initial=0))
+        from ..ops.pallas_dedisperse import rebase_offsets
+
+        offsets, roll_k, max_off = rebase_offsets(offsets, nsamples)
         if max_off > 0:
             max_off = 1 << int(np.ceil(np.log2(max_off + 1)))
         max_off = max(max_off, 256)
@@ -131,7 +140,7 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     compiled = _sharded_kernel(mesh, capture_plane, chan_block, kernel,
                                max_off)
     out = compiled(jnp.asarray(data_padded, dtype=dtype),
-                   jnp.asarray(offsets))
+                   jnp.asarray(offsets), jnp.int32(roll_k))
 
     out = [np.asarray(o)[:ndm] for o in out]
     if capture_plane:
