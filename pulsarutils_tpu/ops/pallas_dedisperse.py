@@ -24,8 +24,18 @@ Design (capability-equivalent of the reference's hot trio
   kernel is then purely linear.
 * The per-(trial, channel) shifts arrive as an SMEM block of int32; the
   inner loop is ``out[d] += window[c, shift[d, c] : shift[d, c] + T_TILE]``
-  — a dynamic *lane slice* from VMEM, which Mosaic lowers to vector
-  rotates instead of a scalarised gather.
+  realised as aligned vector loads plus dynamic rotates (Mosaic forbids
+  unaligned vector loads).  Two layouts:
+
+  - ``layout="rows"`` (default, ~3x faster): each time tile is viewed as
+    ``(8, L)`` row chunks (row s = samples ``[s*L, (s+1)*L)``), so a
+    shifted tile read at offset ``r = q*L + m`` is a 16-row aligned load,
+    one lane-rotate by ``m``, one sublane-rotate by ``q mod 8``, and a
+    two-row blend at the ``L - m`` lane boundary — every op uses all 8
+    sublanes (measured ~150 Gadd/s on v5e vs ~50 for flat).
+  - ``layout="flat"``: (1, t_tile + 128)-lane aligned load plus a sub-128
+    lane-rotate per (trial, channel) — simpler, but each op occupies one
+    sublane of the VPU.
 * Grid is ``(dm_blocks, time_tiles, chan_blocks)`` with channels innermost
   so each output block stays resident in VMEM while all channel blocks
   accumulate into it.
@@ -91,6 +101,103 @@ def _kernel_body(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
     jax.lax.fori_loop(0, dm_block, body, 0)
 
 
+def _kernel_body_rows(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
+                      jnp, pl, pltpu):
+    """Chunked-row variant: full-sublane ops.
+
+    Each time tile is viewed as ``(8, L)`` with ``L = t_tile // 8`` (row s
+    holds samples ``[s*L, (s+1)*L)``), so a shifted read of the whole tile
+    at offset ``r = q*L + m`` is: load window rows ``q..q+8`` (9 rows),
+    lane-rotate the block left by ``m``, and blend each row with its
+    successor at the ``L - m`` lane boundary.  Every op runs on 8-sublane
+    blocks — ~8x the VPU utilisation of the flat (1, t_tile) formulation.
+    """
+    import jax
+
+    data_refs = refs[:k_tiles]
+    out_ref = refs[k_tiles]
+    win_ref = refs[k_tiles + 1]
+    L = t_tile // 8
+
+    i_c = pl.program_id(2)
+
+    @pl.when(i_c == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # stitch the K adjacent (8, L)-chunked tiles into one row window
+    for k in range(k_tiles):
+        win_ref[:, k * 8:(k + 1) * 8, :] = data_refs[k][:, 0]
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (8, L), 1)
+
+    def body(d, carry):
+        acc = out_ref[d, 0]
+        for c in range(chan_block):
+            r = off_ref[0, 0, d, c]
+            q = r // L
+            m = r - q * L
+            # sublane starts must be provably 8-aligned: load 16 rows from
+            # the aligned base (covers q..q+8 since q - qa <= 7), then
+            # rotate rows up by q - qa
+            qa = pl.multiple_of((q // 8) * 8, 8)
+            rows16 = win_ref[c, pl.ds(qa, 16), :]
+            rolled = pltpu.roll(rows16, (L - m) % L, 1)
+            sr = pltpu.roll(rolled, (16 - (q - qa)) % 16, 0)
+            acc = acc + jnp.where(lane < L - m, sr[0:8], sr[1:9])
+        out_ref[d, 0] = acc
+        return carry
+
+    jax.lax.fori_loop(0, dm_block, body, 0)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_kernel_rows(ndm_p, nchan_p, t_ext, t_out, dm_block, chan_block,
+                       t_tile, k_tiles, interpret):
+    jax, jnp, pl, pltpu = _pallas_modules()
+
+    n_dm = ndm_p // dm_block
+    n_t = t_out // t_tile
+    n_chan = nchan_p // chan_block
+    n_src = t_ext // t_tile
+    L = t_tile // 8
+
+    data_specs = [
+        pl.BlockSpec((chan_block, 1, 8, L),
+                     functools.partial(lambda i_d, i_t, i_c, _k:
+                                       (i_c, (i_t + _k) % n_src, 0, 0), _k=k))
+        for k in range(k_tiles)
+    ]
+    off_spec = pl.BlockSpec((1, 1, dm_block, chan_block),
+                            lambda i_d, i_t, i_c: (i_d, i_c, 0, 0),
+                            memory_space=pltpu.SMEM)
+    out_spec = pl.BlockSpec((dm_block, 1, 8, L),
+                            lambda i_d, i_t, i_c: (i_d, i_t, 0, 0))
+
+    kernel = functools.partial(_kernel_body_rows, dm_block=dm_block,
+                               chan_block=chan_block, t_tile=t_tile,
+                               k_tiles=k_tiles, jnp=jnp, pl=pl, pltpu=pltpu)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_dm, n_t, n_chan),
+        in_specs=[off_spec] + data_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((ndm_p, n_t, 8, L), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((chan_block, k_tiles * 8, L),
+                                   jnp.float32)],
+        interpret=bool(interpret),
+    )
+
+    @jax.jit
+    def run(offsets, data_ext):
+        data_4d = data_ext.reshape(nchan_p, n_src, 8, L)
+        out = call(offsets, *([data_4d] * k_tiles))
+        return out.reshape(ndm_p, t_out)
+
+    return run
+
+
 @functools.lru_cache(maxsize=64)
 def _build_kernel(ndm_p, nchan_p, t_ext, t_out, dm_block, chan_block,
                   t_tile, k_tiles, interpret):
@@ -146,13 +253,59 @@ def _build_kernel(ndm_p, nchan_p, t_ext, t_out, dm_block, chan_block,
     return run
 
 
-def _pick_t_tile(max_off, nsamples):
-    """Smallest power-of-two tile >= 2048 that needs at most 2 extra tiles
-    of halo, capped so tiny inputs still work."""
-    t_tile = 2048
-    while t_tile < min(max_off, 1 << 15):
-        t_tile *= 2
+def _pick_t_tile(max_off, nsamples, layout="flat"):
+    """Default time tile: 8192 for the rows layout (measured optimum on
+    v5e), else the smallest power-of-two >= 2048 covering the halo; capped
+    so tiny inputs still work."""
+    if layout == "rows":
+        t_tile = 8192
+    else:
+        t_tile = 2048
+        while t_tile < min(max_off, 1 << 15):
+            t_tile *= 2
     return min(t_tile, max(256, 1 << int(np.floor(np.log2(max(nsamples, 256))))))
+
+
+#: scoped-VMEM budget (bytes) the auto-blocking tries to stay under; the
+#: hardware limit is 16 MB and the pipeline double-buffers in/out blocks
+VMEM_BUDGET = 10 << 20
+
+
+def _halo_tiles(max_off, t_tile, layout):
+    """Number of staggered input tiles covering the shifted-read halo.
+
+    One formula shared by the kernel builder and the VMEM fitter — the
+    footprint model must match the kernel actually built.
+    """
+    if layout == "rows":
+        l_lane = max(1, t_tile // 8)
+        return (max_off // l_lane + 23) // 8
+    return (max_off + 128) // t_tile + 2
+
+
+def _fit_blocks_to_vmem(dm_block, chan_block, t_tile, max_off, layout):
+    """Shrink blocking factors until the kernel's VMEM footprint fits.
+
+    Footprint model: double-buffered data blocks (k_tiles * chan_block *
+    t_tile), the stitched window scratch (same size), and double-buffered
+    output blocks (dm_block * t_tile), all float32.
+    """
+    while True:
+        k_tiles = _halo_tiles(max_off, t_tile, layout)
+        win = chan_block * k_tiles * t_tile * 4
+        data = 2 * k_tiles * chan_block * t_tile * 4
+        out = 2 * dm_block * t_tile * 4
+        if win + data + out <= VMEM_BUDGET:
+            return dm_block, chan_block, t_tile
+        if chan_block > 8:
+            chan_block //= 2
+        elif dm_block > 8:
+            dm_block //= 2
+        elif t_tile > 1024:
+            t_tile //= 2
+        else:
+            return dm_block, chan_block, t_tile  # smallest legal; let
+            # Mosaic report the real limit if this still does not fit
 
 
 def rebase_offsets(offsets, nsamples):
@@ -176,9 +329,9 @@ def rebase_offsets(offsets, nsamples):
     return rebased, k, int(rebased.max(initial=0))
 
 
-def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=64,
-                                   chan_block=8, t_tile=None, interpret=None,
-                                   roll_k=0):
+def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=None,
+                                   chan_block=None, t_tile=None,
+                                   interpret=None, roll_k=0, layout="rows"):
     """Trace-friendly core of :func:`dedisperse_plane_pallas`.
 
     ``data`` and ``offsets`` may be traced jax arrays (e.g. shards inside a
@@ -197,23 +350,41 @@ def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=64,
     ndm = offsets.shape[0]
 
     max_off = int(max_off)
+    if dm_block is None:
+        dm_block = 32
+    if chan_block is None:
+        chan_block = 64
     if t_tile is None:
-        t_tile = _pick_t_tile(max_off, t)
+        t_tile = _pick_t_tile(max_off, t, layout)
     t_tile = int(min(t_tile, t))
 
     dm_block = int(min(dm_block, max(1, ndm)))
     chan_block = int(min(chan_block, nchan))
     if not interpret:
+        # shrink (possibly caller-supplied) blockings that would overrun
+        # scoped VMEM — a compile failure helps nobody
+        dm_block, chan_block, t_tile = _fit_blocks_to_vmem(
+            dm_block, chan_block, t_tile, max_off, layout)
         # Mosaic block rule: trailing block dims must be (8, 128)-divisible
         # or equal to the (padded) array dims.  dm_block/chan_block sit in
-        # the sublane slot of their blocks; t_tile in the lane slot.
+        # the sublane slot of their blocks; t_tile in the lane slot.  For
+        # the rows layout the lane slot holds L = t_tile // 8, so compiled
+        # rows tiles are at least 1024 (an explicit smaller t_tile is
+        # honoured in interpret mode, where Mosaic rules don't apply).
         dm_block = max(8, -(-dm_block // 8) * 8)
         chan_block = max(8, -(-chan_block // 8) * 8)
-        t_tile = max(128, t_tile - t_tile % 128)
+        if layout == "rows":
+            t_tile = max(1024, t_tile - t_tile % 1024)
+        else:
+            t_tile = max(128, t_tile - t_tile % 128)
+    elif layout == "rows":
+        # interpret mode: honour the requested tile, but the (8, L) row
+        # view still needs t_tile divisible by 8
+        t_tile = max(8, t_tile - t_tile % 8)
 
-    # halo covering the worst-case aligned load end: the kernel loads
-    # (t_tile + 128) lanes starting at floor(off / 128) * 128 <= max_off
-    k_tiles = (max_off + 128) // t_tile + 2
+    # halo: rows layout reads window rows qa..qa+15 with qa = 8*(off//(8L));
+    # flat layout loads (t_tile + 128) lanes from floor(off/128)*128
+    k_tiles = _halo_tiles(max_off, t_tile, layout)
 
     # pad trials (duplicate last), channels (zeros), time (circular wrap)
     ndm_p = -(-ndm // dm_block) * dm_block
@@ -254,16 +425,17 @@ def dedisperse_plane_pallas_traced(data, offsets, max_off, dm_block=64,
             reps = max(2, -(-text // t) + 1)
             data_ext = jnp.concatenate([data] * reps, axis=1)[:, :text]
 
-    run = _build_kernel(ndm_p, nchan_p, text, t_out, dm_block, chan_block,
-                        t_tile, k_tiles, interpret)
+    build = _build_kernel_rows if layout == "rows" else _build_kernel
+    run = build(ndm_p, nchan_p, text, t_out, dm_block, chan_block,
+                t_tile, k_tiles, interpret)
     plane = run(offsets, data_ext)[:ndm, :t]
     if roll_k:
         plane = jnp.roll(plane, -roll_k, axis=1)
     return plane
 
 
-def dedisperse_plane_pallas(data, offsets, dm_block=64, chan_block=8,
-                            t_tile=None, interpret=None):
+def dedisperse_plane_pallas(data, offsets, dm_block=None, chan_block=None,
+                            t_tile=None, interpret=None, layout="rows"):
     """Dedispersed plane ``out[d, t] = sum_c data[c, (t + off[d,c]) % T]``.
 
     Parameters
@@ -290,4 +462,4 @@ def dedisperse_plane_pallas(data, offsets, dm_block=64, chan_block=8,
                                           dm_block=dm_block,
                                           chan_block=chan_block,
                                           t_tile=t_tile, interpret=interpret,
-                                          roll_k=roll_k)
+                                          roll_k=roll_k, layout=layout)

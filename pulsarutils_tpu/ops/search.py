@@ -210,9 +210,8 @@ def _search_jax_pallas(data, offsets, capture_plane, dm_block=None,
     outs, planes = [], []
     for lo in range(0, ndm, PALLAS_SUPERBLOCK):
         sub = offsets[lo:lo + PALLAS_SUPERBLOCK]
-        plane = dedisperse_plane_pallas(data, sub,
-                                        dm_block=dm_block or 64,
-                                        chan_block=chan_block or 8)
+        plane = dedisperse_plane_pallas(data, sub, dm_block=dm_block,
+                                        chan_block=chan_block)
         outs.append([np.asarray(o) for o in scorer(plane)])
         if capture_plane:
             planes.append(np.asarray(plane))
