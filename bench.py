@@ -87,7 +87,7 @@ def measure_jax(array, trial_dms, geom, kernel):
         jax_time = time.time() - t0
     if trace_dir:
         log(f"profiler trace written to {trace_dir}")
-    return table, len(trial_dms) / jax_time, jax_time
+    return table, len(trial_dms) / jax_time, jax_time, device_array
 
 
 def measure_numpy_baseline(array, trial_dms, geom, nsamp, ndm):
@@ -171,8 +171,8 @@ def main():
         try:
             for j, kern in enumerate(kernels):
                 try:
-                    table, jax_tps, jax_time = measure_jax(sub, dms, geom,
-                                                           kern)
+                    (table, jax_tps, jax_time,
+                     device_array) = measure_jax(sub, dms, geom, kern)
                     measured_kernel = kern
                     if j > 0:
                         degraded = (f"kernel={kernel} failed; "
@@ -213,6 +213,35 @@ def main():
         return
 
     log(f"JAX steady-state: {jax_time:.3f}s -> {jax_tps:.1f} DM-trials/s")
+
+    # secondary metric: the FDMT tree sweep covers EVERY physically
+    # distinguishable trial in [300, 400] (the canonical integer-delay
+    # plan) in one log-depth transform
+    fdmt = None
+    try:
+        from pulsarutils_tpu.ops.search import dedispersion_search
+
+        dev = device_array  # reuse measure_jax's upload (15-380 s for 4 GB)
+
+        def frun():
+            return dedispersion_search(dev, 300.0, 400.0, *geom,
+                                       backend="jax", kernel="fdmt")
+
+        tf = frun()  # compile + warm
+        t0 = time.time()
+        tf = frun()
+        fdmt_time = time.time() - t0
+        fdmt = {
+            "native_trials": tf.nrows,
+            "full_sweep_s": round(fdmt_time, 3),
+            "trials_per_sec": round(tf.nrows / fdmt_time, 1),
+            "best_dm": float(tf["DM"][tf.argbest()]),
+        }
+        log(f"FDMT full canonical sweep: {fdmt_time:.3f}s "
+            f"({tf.nrows} native trials)")
+    except Exception as exc:
+        log(f"fdmt metric skipped: {exc!r}")
+
     numpy_tps, linearity = measure_numpy_baseline(array, trial_dms, geom,
                                                   nsamp, ndm)
 
@@ -233,6 +262,8 @@ def main():
         "best_dm": float(table["DM"][table.argbest()]),
         "injected_dm": inject_dm,
     }
+    if fdmt:
+        result["fdmt"] = fdmt
     if os.environ.get("BENCH_DEGRADED"):
         degraded = degraded or "degraded run"
     if degraded:

@@ -1,0 +1,482 @@
+"""Fast DM Transform (FDMT): tree dedispersion in O(nchan · T · log nchan).
+
+The direct sweep costs ``O(ndm · nchan · T)`` shifted adds (reference
+``pulsarutils/dedispersion.py:174-202``; our Pallas kernel).  The FDMT
+(Zackay & Ofek 2017, ApJ 835:11) computes **every integer-delay trial at
+once** by recursively merging adjacent frequency sub-bands: partial
+dedispersed sums over a sub-band are reused by all trials that cross it,
+collapsing the trial axis into ``log2(nchan)`` shift-and-add passes.  For
+the benchmark geometry (1024 chan, 512-sample delay span) this is ~100x
+fewer adds than the direct sweep.
+
+Semantics and how they relate to the reference:
+
+* The FDMT's natural trial grid IS the reference's plan (one trial per
+  integer sample of band-crossing delay, ``dedispersion.py:149-171``):
+  row ``N`` of the transform sums one sample per channel along the
+  dispersion track whose differential delay across the full band is ``N``
+  samples.  DM values are recovered with the same inversion the plan uses.
+* Per-channel delays along a track are rounded *recursively* (each merge
+  rounds the track's crossing of the sub-band boundary) instead of
+  directly per channel, so individual channel delays can differ from the
+  reference's ``rint(delay // tsamp)`` by ~1 sample (Zackay & Ofek §2.3
+  bound the deviation).  Hit detection therefore agrees with the exact
+  kernels to within a trial, but is not bit-identical — use
+  ``kernel="pallas"`` when bit-exact parity with the NumPy reference path
+  matters, ``kernel="fdmt"`` for throughput.
+* Time shifts are circular (the reference's ``np.roll`` convention,
+  ``dedispersion.py:60-98``), so no edge-validity bookkeeping is needed.
+* Rows are anchored at the top of the band: row ``N`` equals the exact
+  trial's series up to a small per-trial circular rotation (scores are
+  rotation-invariant; the boxcar scorer sees windows shifted by a few
+  samples, a sub-percent S/N effect).
+
+Implementation notes (TPU):
+
+* Each merge pass is ONE fused Pallas kernel launch: for every output row
+  ``(band, Δ)`` it reads the two parent rows directly from the state
+  array — row indices arrive via scalar-prefetch (the BlockSpec index
+  maps read them from SMEM), so the XLA-level gather never materialises —
+  applies the re-anchoring circular shift to the low-band row with the
+  aligned-load + rotate + blend scheme of
+  :mod:`.pallas_dedisperse` (chunked ``(8, L)`` row layout, full-sublane
+  ops), adds, and writes the output tile.
+* Off TPU (or for time axes no power-of-two tile divides) the same merge
+  runs as an XLA ``take_along_axis`` + per-row roll fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .plan import DM_DELAY_CONST, delta_delay
+
+
+# ---------------------------------------------------------------------------
+# Plan: per-iteration merge tables (host, numpy, static)
+# ---------------------------------------------------------------------------
+
+def _lam(f):
+    return f ** -2.0
+
+
+class FdmtPlan:
+    """Static merge schedule for one (nchan, geometry, max_delay) triple.
+
+    Attributes
+    ----------
+    iterations : list of dict with keys
+        ``idx_low``, ``idx_high`` — (rows_out,) int32 flat parent-row
+        indices into the previous state's row axis;
+        ``shift`` — (rows_out,) int32 circular shift applied to the
+        low-band parent row;
+        ``shift_high`` — (rows_out,) int32 shift for the high parent
+        (leaf merge only; ``None`` for deeper iterations);
+        ``nbands``, ``ndelay`` — output layout (rows_out = sum(ndelay)).
+    nchan_padded : channel count rounded up to a power of two (the extra
+        channels are zero and contribute nothing).
+    max_delay : largest differential band delay (inclusive) produced.
+    """
+
+    def __init__(self, nchan, start_freq, bandwidth, max_delay):
+        self.nchan = nchan
+        self.max_delay = int(max_delay)
+        nch2 = 1
+        while nch2 < nchan:
+            nch2 *= 2
+        self.nchan_padded = nch2
+        # zero-padded channels sit ABOVE the real band: they must not
+        # stretch the physical frequency span, so give them zero bandwidth
+        # by keeping the per-channel width of the real band
+        df = bandwidth / nchan
+        f_edge = lambda c: start_freq + min(c, nchan) * df  # noqa: E731
+        maxn = self.max_delay
+
+        # Flat row layout with PER-BAND delay counts, allocated top-down:
+        # only the (band, delay) rows some final trial actually requests
+        # exist.  (Padding every band to the bottom band's depth, or even
+        # a uniform +1 slack per band, inflates the 1M-sample state past
+        # HBM.)  The initial state is the raw data itself — one row per
+        # channel, NO delay expansion: the first merge samples each
+        # channel directly with per-parent shifts (``shift_high`` = the
+        # track's delay at the high channel's lower edge, ``shift`` = at
+        # the low channel's lower edge — the reference's frequency
+        # convention, ``dedispersion.py:127,135``).  Deeper merges only
+        # shift the low parent (the high parent is already anchored).
+        # State rows: band-major, delay-minor, nd[b] slots for band b.
+
+        # pass A (top-down): per-iteration band split fractions, then the
+        # maximum delay index each band is ever asked for
+        widths = []
+        w = 1
+        while w < nch2:
+            widths.append(w)
+            w *= 2
+        fracs = []  # fracs[i][b]: high-band share of band b's delay split
+        for w in widths:
+            nb = nch2 // (2 * w)
+            fr = np.empty(nb)
+            for b in range(nb):
+                c0, c1, c2 = 2 * b * w, (2 * b + 1) * w, (2 * b + 2) * w
+                w02 = _lam(f_edge(c0)) - _lam(f_edge(c2))
+                w12 = _lam(f_edge(c1)) - _lam(f_edge(c2))
+                fr[b] = w12 / w02 if w02 > 0 else 0.0
+            fracs.append(fr)
+        used = [None] * (len(widths) + 1)
+        used[-1] = np.asarray([maxn])  # final band serves Δ = 0..maxn
+        for i in range(len(widths) - 1, 0, -1):
+            u_out = used[i + 1]
+            nb = len(u_out)
+            u_in = np.zeros(2 * nb, np.int64)
+            for b in range(nb):
+                dd = np.arange(u_out[b] + 1)
+                dh = np.round(dd * fracs[i][b]).astype(np.int64)
+                u_in[2 * b] = (dd - dh).max(initial=0)
+                u_in[2 * b + 1] = dh.max(initial=0)
+            used[i] = u_in
+
+        # pass B (bottom-up): flat index tables over the allocated rows
+        self.iterations = []
+        nd_in = [1] * nch2  # the raw channels
+        for i, w in enumerate(widths):
+            nd_out = [int(u) + 1 for u in used[i + 1]]
+            in_off = np.concatenate([[0], np.cumsum(nd_in)])
+            out_rows = int(np.sum(nd_out))
+            idx_low = np.empty(out_rows, np.int32)
+            idx_high = np.empty(out_rows, np.int32)
+            shift = np.empty(out_rows, np.int32)
+            shift_high = np.zeros(out_rows, np.int32) if i == 0 else None
+            pos = 0
+            for b in range(len(nd_out)):
+                dd = np.arange(nd_out[b])
+                dh = np.round(dd * fracs[i][b]).astype(np.int64)
+                dl = dd - dh
+                if i == 0:
+                    # leaf merge: parents are raw channel rows, sampled
+                    # at the track's delay at their lower edges (relative
+                    # to the pair's top edge): high -> dh, low -> dd
+                    idx_low[pos:pos + len(dd)] = in_off[2 * b]
+                    idx_high[pos:pos + len(dd)] = in_off[2 * b + 1]
+                    shift[pos:pos + len(dd)] = dd
+                    shift_high[pos:pos + len(dd)] = dh
+                else:
+                    assert dh.max(initial=0) < nd_in[2 * b + 1], (i, b)
+                    assert dl.max(initial=0) < nd_in[2 * b], (i, b)
+                    idx_low[pos:pos + len(dd)] = in_off[2 * b] + dl
+                    idx_high[pos:pos + len(dd)] = in_off[2 * b + 1] + dh
+                    shift[pos:pos + len(dd)] = dh
+                pos += len(dd)
+            self.iterations.append({
+                "idx_low": idx_low,
+                "idx_high": idx_high,
+                "shift": shift,
+                "shift_high": shift_high,
+                "nbands": len(nd_out),
+                "ndelay": nd_out,
+            })
+            nd_in = nd_out
+
+
+@functools.lru_cache(maxsize=32)
+def fdmt_plan(nchan, start_freq, bandwidth, max_delay):
+    """Cached :class:`FdmtPlan` (all-static inputs)."""
+    return FdmtPlan(nchan, start_freq, bandwidth, max_delay)
+
+
+def max_band_delay(nchan, dmmax, start_freq, bandwidth, sample_time):
+    """Largest integer band-crossing delay for ``dmmax`` (plan row count)."""
+    return int(np.ceil(
+        delta_delay(float(dmmax), start_freq, start_freq + bandwidth)
+        / sample_time))
+
+
+# ---------------------------------------------------------------------------
+# Merge executors
+# ---------------------------------------------------------------------------
+
+def _merge_xla(state, idx_low, idx_high, shift, shift_high=None):
+    """Portable merge: row gathers + per-row circular roll via gather."""
+    import jax.numpy as jnp
+
+    t = state.shape[-1]
+    low = state[idx_low]                      # (rows_out, T)
+    high = state[idx_high]
+    tidx = jnp.arange(t, dtype=jnp.int32)
+    gather = (tidx[None, :] + shift[:, None]) % t
+    low = jnp.take_along_axis(low, gather, axis=1)
+    if shift_high is not None:
+        gather_h = (tidx[None, :] + shift_high[:, None]) % t
+        high = jnp.take_along_axis(high, gather_h, axis=1)
+    return high + low
+
+
+def _pick_fdmt_tile(t):
+    """Largest power-of-two tile in [1024, 8192] dividing ``t`` (0 if none)."""
+    for t_tile in (8192, 4096, 2048, 1024):
+        if t % t_tile == 0:
+            return t_tile
+    return 0
+
+
+#: output rows processed per merge-kernel grid step; amortises the
+#: per-step Pallas/DMA orchestration overhead (the kernel is otherwise
+#: grid-overhead-bound: one row per step = ~1.4M steps per transform)
+MERGE_ROW_BLOCK = 16
+
+
+@functools.lru_cache(maxsize=64)
+def _build_merge_kernel(rows_out, rows_in, t, t_tile, k_tiles, k_tiles_h,
+                        row_block, interpret):
+    """Fused FDMT merge: ``out[r] = roll(high[ih[r]], sh[r]) +
+    roll(low[il[r]], s[r])``, ``row_block`` rows per grid step.
+
+    ``k_tiles_h = 0`` compiles the common asymmetric form (high parent
+    read aligned, no rotation) used by every iteration except the leaf
+    merge.  ``rows_out`` must be a multiple of ``row_block`` (callers pad
+    the tables; padded rows write junk rows that are sliced off).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from .pallas_dedisperse import shifted_row_tile
+
+    L = t_tile // 8
+    n_t = t // t_tile
+    kh = max(1, k_tiles_h)
+
+    def shifted_tile(win_ref, r, lane, jnp, pl, pltpu):
+        return shifted_row_tile(win_ref, None, r, L, lane, jnp, pl, pltpu)
+
+    def kernel(idx_low_ref, idx_high_ref, shift_ref, shift_high_ref,
+               *refs):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (8, L), 1)
+        nin = row_block * (k_tiles + kh)
+        out_ref = refs[nin]
+        win_ref = refs[nin + 1]
+        win_h_ref = refs[nin + 2] if k_tiles_h else None
+        i_r = pl.program_id(0)
+
+        for j in range(row_block):
+            low_refs = refs[j * k_tiles:(j + 1) * k_tiles]
+            high_refs = refs[row_block * k_tiles + j * kh:
+                             row_block * k_tiles + (j + 1) * kh]
+            # stitch the low-band row's staggered (8, L) chunks
+            for k in range(k_tiles):
+                win_ref[k * 8:(k + 1) * 8, :] = low_refs[k][0, 0]
+            low_tile = shifted_tile(win_ref, shift_ref[i_r * row_block + j],
+                                    lane, jnp, pl, pltpu)
+            if k_tiles_h:
+                for k in range(k_tiles_h):
+                    win_h_ref[k * 8:(k + 1) * 8, :] = high_refs[k][0, 0]
+                high_tile = shifted_tile(
+                    win_h_ref, shift_high_ref[i_r * row_block + j], lane,
+                    jnp, pl, pltpu)
+            else:
+                high_tile = high_refs[0][0, 0]
+            out_ref[j, 0] = high_tile + low_tile
+
+    # scalar-prefetch index maps: parent rows are chosen per grid step by
+    # the prefetched tables, so no gathered copy of the state is ever
+    # materialised
+    def low_spec(j, k):
+        return pl.BlockSpec(
+            (1, 1, 8, L),
+            functools.partial(lambda i_r, i_t, il, ih, sh, shh, _j, _k:
+                              (il[i_r * row_block + _j],
+                               (i_t + _k) % n_t, 0, 0), _j=j, _k=k))
+
+    def high_spec(j, k):
+        return pl.BlockSpec(
+            (1, 1, 8, L),
+            functools.partial(lambda i_r, i_t, il, ih, sh, shh, _j, _k:
+                              (ih[i_r * row_block + _j],
+                               (i_t + _k) % n_t, 0, 0), _j=j, _k=k))
+
+    low_specs = [low_spec(j, k) for j in range(row_block)
+                 for k in range(k_tiles)]
+    high_specs = [high_spec(j, k) for j in range(row_block)
+                  for k in range(kh)]
+    out_spec = pl.BlockSpec(
+        (row_block, 1, 8, L),
+        lambda i_r, i_t, il, ih, sh, shh: (i_r, i_t, 0, 0))
+
+    scratch = [pltpu.VMEM((k_tiles * 8, L), jnp.float32)]
+    if k_tiles_h:
+        scratch.append(pltpu.VMEM((k_tiles_h * 8, L), jnp.float32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(rows_out // row_block, n_t),
+        in_specs=low_specs + high_specs,
+        out_specs=out_spec,
+        scratch_shapes=scratch,
+    )
+    call = pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=jax.ShapeDtypeStruct(
+                              (rows_out, n_t, 8, L), jnp.float32),
+                          interpret=bool(interpret))
+
+    @jax.jit
+    def run(state, idx_low, idx_high, shift, shift_high):
+        s4 = state.reshape(rows_in, n_t, 8, L)
+        n_in = row_block * (k_tiles + kh)
+        out = call(idx_low, idx_high, shift, shift_high,
+                   *([s4] * n_in))
+        return out.reshape(rows_out, t)
+
+    return run
+
+
+def _merge_pallas(state, it, t_tile, interpret):
+    import jax.numpy as jnp
+
+    rows_in, t = state.shape
+    rows_out = len(it["idx_low"])
+    L = t_tile // 8
+    max_shift = int(it["shift"].max(initial=0))
+    k_tiles = (max_shift // L + 23) // 8
+
+    row_block = min(MERGE_ROW_BLOCK, rows_out)
+    pad = (-rows_out) % row_block
+    idx_low = np.concatenate([it["idx_low"],
+                              it["idx_low"][-1:].repeat(pad)])
+    idx_high = np.concatenate([it["idx_high"],
+                               it["idx_high"][-1:].repeat(pad)])
+    shift = np.concatenate([it["shift"], it["shift"][-1:].repeat(pad)])
+
+    if it["shift_high"] is not None:
+        max_sh = int(it["shift_high"].max(initial=0))
+        k_tiles_h = (max_sh // L + 23) // 8
+        shift_high = np.concatenate([it["shift_high"],
+                                     it["shift_high"][-1:].repeat(pad)])
+    else:
+        k_tiles_h = 0
+        shift_high = np.zeros(rows_out + pad, np.int32)
+    run = _build_merge_kernel(rows_out + pad, rows_in, t, t_tile, k_tiles,
+                              k_tiles_h, row_block, interpret)
+    out = run(state, jnp.asarray(idx_low), jnp.asarray(idx_high),
+              jnp.asarray(shift), jnp.asarray(shift_high))
+    return out[:rows_out] if pad else out
+
+
+@functools.lru_cache(maxsize=16)
+def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
+                     use_pallas, interpret, n_lo=0, with_scores=False,
+                     with_plane=True):
+    """One jitted program: merges [+ slice to rows n_lo.. + scoring].
+
+    Fusing the row slice and the scorer into the program keeps the live
+    set between calls near zero — returning the full (max_delay+1, T)
+    state keeps gigabytes alive and OOMs back-to-back searches at the
+    1M-sample size.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    plan = fdmt_plan(nchan, start_freq, bandwidth, max_delay)
+
+    def fn(data):
+        state = data
+        if nchan < plan.nchan_padded:
+            state = jnp.concatenate(
+                [state,
+                 jnp.zeros((plan.nchan_padded - nchan, t), state.dtype)])
+        for it in plan.iterations:
+            if use_pallas:
+                state = _merge_pallas(state, it, t_tile, interpret)
+            else:
+                sh = (jnp.asarray(it["shift_high"])
+                      if it["shift_high"] is not None else None)
+                state = _merge_xla(state, jnp.asarray(it["idx_low"]),
+                                   jnp.asarray(it["idx_high"]),
+                                   jnp.asarray(it["shift"]), sh)
+        plane = state[n_lo:max_delay + 1]
+        if not with_scores:
+            return plane
+        from .search import score_profiles
+
+        scores = score_profiles(plane, xp=jnp)
+        return scores + ((plane,) if with_plane else ())
+
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Public transform + search
+# ---------------------------------------------------------------------------
+
+def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None):
+    """All integer-delay dedispersed series of ``data`` at once.
+
+    Parameters
+    ----------
+    data : (nchan, T) array (host or device).
+    max_delay : largest differential band delay (samples, inclusive).
+    start_freq, bandwidth : band geometry in MHz (channel = lower edge,
+        reference convention ``dedispersion.py:127,135``).
+    use_pallas : force the Pallas (True) or XLA (False) merge; default
+        auto (Pallas on TPU when a power-of-two tile divides T).
+
+    Returns
+    -------
+    (max_delay + 1, T) float32 device array: row ``N`` sums one sample
+    per channel along the track with band-crossing delay ``N``, anchored
+    at the top of the band.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    data = jnp.asarray(data, dtype=jnp.float32)
+    nchan, t = data.shape
+    plan = fdmt_plan(nchan, float(start_freq), float(bandwidth),
+                     int(max_delay))
+
+    t_tile = _pick_fdmt_tile(t)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and t_tile > 0
+    if use_pallas and t_tile == 0:
+        raise ValueError(
+            f"no power-of-two tile in [1024, 8192] divides T={t}; "
+            "pad the time axis or pass use_pallas=False")
+
+    # The whole transform runs as ONE jitted program: enqueueing the
+    # merges eagerly allocates every intermediate state up-front (~4x the
+    # live set — an HBM OOM at the 1M-sample size), whereas XLA's buffer
+    # assignment inside a single program frees each state as soon as its
+    # consumer has read it.
+    run = _build_transform(nchan, float(start_freq), float(bandwidth),
+                           int(max_delay), t, t_tile, bool(use_pallas),
+                           jax.default_backend() != "tpu")
+    return run(data)
+
+
+def fdmt_trial_dms(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time):
+    """The FDMT's integer band-delay trial grid on ``[dmmin, dmmax]``.
+
+    Same one-sample spacing as the reference plan, but snapped to integer
+    band delays — the reference's ``arange(min_n, max_n + 1)`` grid sits
+    at the *fractional* offset of ``min_n`` (``dedispersion.py:165-168``),
+    so DM values (and occasionally the trial count) differ from the plan
+    by up to one trial.
+
+    Returns ``(trial_dms, n_lo, n_hi)`` where rows ``n_lo..n_hi`` of the
+    transform correspond to the returned DMs (same inversion as
+    ``dedispersion_plan``, reference ``dedispersion.py:168-169``).
+    """
+    f0 = float(start_freq)
+    f1 = f0 + float(bandwidth)
+    n_lo = int(np.ceil(delta_delay(float(dmmin), f0, f1) / sample_time))
+    n_hi = int(np.floor(delta_delay(float(dmmax), f0, f1) / sample_time))
+    if n_hi < n_lo:
+        # the range is narrower than one band-delay sample and straddles
+        # no integer: return the single nearest trial (never an empty
+        # grid — every other backend guarantees >= 1 trial)
+        n_hi = n_lo
+    trial_n = np.arange(n_lo, n_hi + 1)
+    trial_dm = (trial_n * sample_time / DM_DELAY_CONST
+                / (f0 ** -2.0 - f1 ** -2.0))
+    return trial_dm, n_lo, n_hi

@@ -101,6 +101,29 @@ def _kernel_body(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
     jax.lax.fori_loop(0, dm_block, body, 0)
 
 
+def shifted_row_tile(win_ref, c, r, L, lane, jnp, pl, pltpu):
+    """Read ``window[r : r + 8L]`` as an (8, L) chunked tile.
+
+    The circular-shift primitive shared by the rows-layout dedispersion
+    kernel and the FDMT merge kernel: with ``r = q*L + m``, load 16
+    window rows from the 8-aligned base (sublane starts must be provably
+    8-aligned), lane-rotate left by ``m``, sublane-rotate up by
+    ``q mod 8``, and blend each row with its successor at the ``L - m``
+    lane boundary.  ``c`` indexes the leading dim of a 3-D window ref
+    (``None`` for a 2-D ref); ``lane`` is a (8, L) lane iota.
+    """
+    q = r // L
+    m = r - q * L
+    qa = pl.multiple_of((q // 8) * 8, 8)
+    if c is None:
+        rows16 = win_ref[pl.ds(qa, 16), :]
+    else:
+        rows16 = win_ref[c, pl.ds(qa, 16), :]
+    rolled = pltpu.roll(rows16, (L - m) % L, 1)
+    sr = pltpu.roll(rolled, (16 - (q - qa)) % 16, 0)
+    return jnp.where(lane < L - m, sr[0:8], sr[1:9])
+
+
 def _kernel_body_rows(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
                       jnp, pl, pltpu):
     """Chunked-row variant: full-sublane ops.
@@ -134,17 +157,8 @@ def _kernel_body_rows(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
     def body(d, carry):
         acc = out_ref[d, 0]
         for c in range(chan_block):
-            r = off_ref[0, 0, d, c]
-            q = r // L
-            m = r - q * L
-            # sublane starts must be provably 8-aligned: load 16 rows from
-            # the aligned base (covers q..q+8 since q - qa <= 7), then
-            # rotate rows up by q - qa
-            qa = pl.multiple_of((q // 8) * 8, 8)
-            rows16 = win_ref[c, pl.ds(qa, 16), :]
-            rolled = pltpu.roll(rows16, (L - m) % L, 1)
-            sr = pltpu.roll(rolled, (16 - (q - qa)) % 16, 0)
-            acc = acc + jnp.where(lane < L - m, sr[0:8], sr[1:9])
+            acc = acc + shifted_row_tile(win_ref, c, off_ref[0, 0, d, c],
+                                         L, lane, jnp, pl, pltpu)
         out_ref[d, 0] = acc
         return carry
 

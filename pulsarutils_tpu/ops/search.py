@@ -221,6 +221,40 @@ def _search_jax_pallas(data, offsets, capture_plane, dm_block=None,
     return maxvalues, stds, best_snrs, best_windows, plane
 
 
+def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
+                     capture_plane):
+    """FDMT sweep: every integer-delay trial in one log-depth transform.
+
+    Trial grid is the FDMT's natural (= the reference plan's) integer
+    band-delay grid on ``[dmmin, dmmax]`` — see
+    :func:`pulsarutils_tpu.ops.fdmt.fdmt_trial_dms`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .fdmt import _build_transform, _pick_fdmt_tile, fdmt_trial_dms
+
+    nchan = data.shape[0]
+    trial_dms, n_lo, n_hi = fdmt_trial_dms(nchan, dmmin, dmmax, start_freq,
+                                           bandwidth, sample_time)
+    data = jnp.asarray(data, jnp.float32)
+    t = data.shape[1]
+    t_tile = _pick_fdmt_tile(t)
+    use_pallas = jax.default_backend() == "tpu" and t_tile > 0
+    # scoring (and the row slice) run inside the transform's jit: only
+    # the per-trial score vectors (and optionally the plane) leave the
+    # device, keeping back-to-back searches within HBM
+    run = _build_transform(nchan, float(start_freq), float(bandwidth),
+                           n_hi, t, t_tile, use_pallas,
+                           jax.default_backend() != "tpu", n_lo=n_lo,
+                           with_scores=True, with_plane=capture_plane)
+    out = run(data)
+    maxvalues, stds, best_snrs, best_windows = (
+        np.asarray(o) for o in out[:4])
+    plane_out = np.asarray(out[4]) if capture_plane else None
+    return trial_dms, maxvalues, stds, best_snrs, best_windows, plane_out
+
+
 def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
                 capture_plane, dm_block, chan_block, dtype, kernel="auto"):
     import jax
@@ -293,8 +327,12 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     dtype : device dtype for the JAX path (default float32).
     kernel : JAX-path kernel selector: ``"auto"`` (Pallas on TPU, gather
         elsewhere), ``"pallas"`` (hand-written tiled TPU kernel, see
-        :mod:`.pallas_dedisperse`) or ``"gather"`` (portable XLA
-        ``take_along_axis`` formulation).
+        :mod:`.pallas_dedisperse`), ``"gather"`` (portable XLA
+        ``take_along_axis`` formulation) or ``"fdmt"`` (tree dedispersion,
+        O(nchan log nchan) instead of O(ndm * nchan) — fastest for dense
+        DM sweeps; uses its own integer band-delay trial grid and tree-
+        rounded tracks, so hits agree with the exact kernels to within a
+        trial but not bit-identically; see :mod:`.fdmt`).
 
     Returns
     -------
@@ -303,12 +341,40 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     ``show``/``capture_plane``.
     """
     nchan = data.shape[0]
+    if capture_plane is None:
+        capture_plane = bool(show)
+
+    if kernel == "fdmt":
+        # the FDMT computes its own trial grid: the plan's one-sample
+        # spacing snapped to integer band delays (the plan itself sits at
+        # a fractional offset, so values/count can differ by one trial);
+        # an explicit trial_dms only bounds the DM range.  dm_block /
+        # chan_block do not apply to the tree transform.
+        if backend != "jax":
+            raise ValueError("kernel='fdmt' requires backend='jax'")
+        import jax.numpy as _jnp
+
+        if dtype not in (None, _jnp.float32):
+            raise ValueError("kernel='fdmt' supports float32 only")
+        if trial_dms is not None:
+            dmmin = float(np.min(trial_dms))
+            dmmax = float(np.max(trial_dms))
+        (trial_dms, maxvalues, stds, best_snrs, best_windows,
+         plane) = _search_jax_fdmt(data, dmmin, dmmax, start_freq,
+                                   bandwidth, sample_time, capture_plane)
+        table = ResultTable({
+            "DM": trial_dms,
+            "max": maxvalues,
+            "std": stds,
+            "snr": best_snrs,
+            "rebin": best_windows,
+        })
+        return (table, plane) if (capture_plane or show) else table
+
     if trial_dms is None:
         trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
                                       bandwidth, sample_time)
     trial_dms = np.asarray(trial_dms, dtype=np.float64)
-    if capture_plane is None:
-        capture_plane = bool(show)
 
     if backend == "numpy":
         maxvalues, stds, best_snrs, best_windows, plane = _search_numpy(

@@ -1,0 +1,151 @@
+"""FDMT tree dedispersion: track correctness, round-trip DM recovery,
+and agreement with the exact kernels.
+
+The FDMT's per-channel delays are tree-rounded (each merge rounds the
+track's sub-band crossing), so planes are compared against a brute-force
+summation along the SAME tree-rounded tracks (exact equality), while
+search results are compared statistically (recovered DM within one trial
+of the exact backend).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pulsarutils_tpu.models.simulate import simulate_test_data
+from pulsarutils_tpu.ops.fdmt import (
+    fdmt_plan,
+    fdmt_transform,
+    fdmt_trial_dms,
+    max_band_delay,
+)
+from pulsarutils_tpu.ops.search import dedispersion_search
+
+GEOM = (1200.0, 200.0, 0.0005)  # start_freq, bandwidth, tsamp
+
+
+def brute_force_tracks(data, plan, max_delay):
+    """Recompute every row by walking the plan's merge tables on the host.
+
+    Returns the per-(row, channel) sample delays the tree encodes, then
+    sums ``data`` along them — the ground truth for the transform.
+    """
+    nchan, t = data.shape
+    nch2 = plan.nchan_padded
+    # delays[row] = {channel: sample delay}; init: raw channels
+    state_delays = [{c: 0} for c in range(nch2)]
+    for it in plan.iterations:
+        new = []
+        for r in range(len(it["idx_low"])):
+            low = state_delays[it["idx_low"][r]]
+            high = state_delays[it["idx_high"][r]]
+            s = int(it["shift"][r])
+            sh = int(it["shift_high"][r]) if it["shift_high"] is not None \
+                else 0
+            merged = {c: d + s for c, d in low.items()}
+            merged.update({c: d + sh for c, d in high.items()})
+            new.append(merged)
+        state_delays = new
+    out = np.zeros((max_delay + 1, t))
+    for n in range(max_delay + 1):
+        for c, d in state_delays[n].items():
+            if c < nchan:
+                out[n] += np.roll(data[c], -d)
+    return out
+
+
+class TestTransform:
+    def test_matches_tree_tracks_exactly(self):
+        rng = np.random.default_rng(0)
+        nchan, t = 16, 512
+        data = rng.normal(0, 1, (nchan, t)).astype(np.float32)
+        max_delay = 40
+        plan = fdmt_plan(nchan, GEOM[0], GEOM[1], max_delay)
+        ref = brute_force_tracks(data, plan, max_delay)
+        out = np.asarray(fdmt_transform(data, max_delay, GEOM[0], GEOM[1]))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+    def test_pallas_merge_matches_xla_merge(self):
+        rng = np.random.default_rng(1)
+        nchan, t = 8, 2048  # t divisible by 1024 -> pallas path possible
+        data = rng.normal(0, 1, (nchan, t)).astype(np.float32)
+        a = np.asarray(fdmt_transform(data, 30, GEOM[0], GEOM[1],
+                                      use_pallas=False))
+        b = np.asarray(fdmt_transform(data, 30, GEOM[0], GEOM[1],
+                                      use_pallas=True))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-4)
+
+    def test_row_zero_is_plain_channel_sum(self):
+        rng = np.random.default_rng(2)
+        data = rng.normal(0, 1, (8, 256)).astype(np.float32)
+        out = np.asarray(fdmt_transform(data, 10, GEOM[0], GEOM[1]))
+        np.testing.assert_allclose(out[0], data.sum(axis=0), rtol=1e-5,
+                                   atol=1e-4)
+
+    def test_nonpow2_channels_padded(self):
+        rng = np.random.default_rng(3)
+        data = rng.normal(0, 1, (12, 256)).astype(np.float32)
+        out = np.asarray(fdmt_transform(data, 10, GEOM[0], GEOM[1]))
+        np.testing.assert_allclose(out[0], data.sum(axis=0), rtol=1e-5,
+                                   atol=1e-4)
+
+
+class TestSearch:
+    def test_roundtrip_recovers_injected_dm(self):
+        array, header = simulate_test_data(150, nchan=64, nsamples=4096,
+                                           rng=7)
+        args = (100, 200.0, header["fbottom"], header["bandwidth"],
+                header["tsamp"])
+        t_np = dedispersion_search(array, *args, backend="numpy")
+        t_fd = dedispersion_search(array, *args, backend="jax",
+                                   kernel="fdmt")
+        dm_np = float(t_np["DM"][t_np.argbest()])
+        dm_fd = float(t_fd["DM"][t_fd.argbest()])
+        spacing = float(t_fd["DM"][1] - t_fd["DM"][0])
+        assert abs(dm_fd - dm_np) <= 1.5 * spacing
+        assert abs(dm_fd - 150.0) <= 2 * spacing
+
+    def test_trial_grid_matches_plan_spacing(self):
+        trial_dms, n_lo, n_hi = fdmt_trial_dms(64, 100, 200.0, *GEOM)
+        assert n_hi > n_lo
+        assert len(trial_dms) == n_hi - n_lo + 1
+        # integer band-delay grid: delta_delay(dm)/tsamp is integral
+        from pulsarutils_tpu.ops.plan import delta_delay
+
+        n = delta_delay(trial_dms, GEOM[0], GEOM[0] + GEOM[1]) / GEOM[2]
+        np.testing.assert_allclose(n, np.round(n), atol=1e-6)
+
+    def test_capture_plane_shape(self):
+        array, header = simulate_test_data(150, nchan=32, nsamples=2048,
+                                           rng=8)
+        t_fd, plane = dedispersion_search(
+            array, 120, 180.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"], backend="jax", kernel="fdmt", show=True)
+        assert plane.shape == (t_fd.nrows, array.shape[1])
+
+    def test_fdmt_requires_jax_backend(self):
+        array, header = simulate_test_data(150, nchan=16, nsamples=512,
+                                           rng=9)
+        with pytest.raises(ValueError):
+            dedispersion_search(array, 100, 200.0, header["fbottom"],
+                                header["bandwidth"], header["tsamp"],
+                                backend="numpy", kernel="fdmt")
+
+
+class TestPlanTables:
+    def test_indices_in_range(self):
+        plan = fdmt_plan(64, GEOM[0], GEOM[1], 100)
+        rows_in = plan.nchan_padded
+        for it in plan.iterations:
+            assert it["idx_low"].max() < rows_in
+            assert it["idx_high"].max() < rows_in
+            assert (it["shift"] >= 0).all()
+            rows_in = len(it["idx_low"])
+
+    def test_max_band_delay(self):
+        n = max_band_delay(64, 200.0, *GEOM)
+        from pulsarutils_tpu.ops.plan import delta_delay
+
+        assert n == int(np.ceil(delta_delay(200.0, GEOM[0],
+                                            GEOM[0] + GEOM[1]) / GEOM[2]))
