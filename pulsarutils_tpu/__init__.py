@@ -108,6 +108,10 @@ def __getattr__(name):
                                         "sharded_dedispersion_search"),
         "ring_dedisperse": ("parallel.stream", "ring_dedisperse"),
         "make_mesh": ("parallel.mesh", "make_mesh"),
+        "fdmt_transform": ("ops.fdmt", "fdmt_transform"),
+        "fdmt_trial_dms": ("ops.fdmt", "fdmt_trial_dms"),
+        "initialize_distributed": ("parallel.multihost", "initialize"),
+        "pod_mesh": ("parallel.multihost", "pod_mesh"),
     }
     if name in lazy:
         import importlib

@@ -30,6 +30,11 @@ def build_parser():
     parser.add_argument("--snr-threshold", type=float, default=6.0)
     parser.add_argument("--surelybad", type=int, nargs="*", default=[])
     parser.add_argument("--backend", choices=("jax", "numpy"), default="jax")
+    parser.add_argument("--kernel",
+                        choices=("auto", "pallas", "gather", "fdmt"),
+                        default="auto",
+                        help="jax-path kernel; fdmt = tree dedispersion "
+                             "(fastest dense sweep, tree-rounded tracks)")
     parser.add_argument("--fft-zap", action="store_true",
                         help="excise periodic RFI in the Fourier domain")
     parser.add_argument("--cut-outliers", action="store_true",
@@ -61,6 +66,7 @@ def main(args=None):
             dmmax=opts.dmmax,
             surelybad=opts.surelybad,
             backend=opts.backend,
+            kernel=opts.kernel,
             snr_threshold=opts.snr_threshold,
             output_dir=opts.output_dir,
             make_plots=False if opts.plots == "none" else opts.plots,

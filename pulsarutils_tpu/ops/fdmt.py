@@ -220,6 +220,33 @@ def _pick_fdmt_tile(t):
     return 0
 
 
+def _transform_setup(data, use_pallas):
+    """Resolve the Pallas/XLA choice and tile for a time axis of length T.
+
+    When the Pallas path is wanted but no power-of-two tile divides T,
+    the data is zero-padded to the next multiple of 1024 (the XLA gather
+    fallback scalarises on TPU); circular wraps then cross the short zero
+    pad — an edge effect of the same order as the tree's track rounding.
+    The caller slices outputs back to ``t_orig``.
+
+    Returns ``(data, t_run, t_tile, use_pallas, interpret, t_orig)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    t = data.shape[1]
+    t_run = t
+    t_tile = _pick_fdmt_tile(t)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas and t_tile == 0:
+        t_run = -(-t // 1024) * 1024
+        data = jnp.pad(data, ((0, 0), (0, t_run - t)))
+        t_tile = _pick_fdmt_tile(t_run)
+    return (data, t_run, t_tile, bool(use_pallas),
+            jax.default_backend() != "tpu", t)
+
+
 #: output rows processed per merge-kernel grid step; amortises the
 #: per-step Pallas/DMA orchestration overhead (the kernel is otherwise
 #: grid-overhead-bound: one row per step = ~1.4M steps per transform)
@@ -366,7 +393,7 @@ def _merge_pallas(state, it, t_tile, interpret):
 @functools.lru_cache(maxsize=16)
 def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                      use_pallas, interpret, n_lo=0, with_scores=False,
-                     with_plane=True):
+                     with_plane=True, t_orig=None):
     """One jitted program: merges [+ slice to rows n_lo.. + scoring].
 
     Fusing the row slice and the scorer into the program keeps the live
@@ -395,6 +422,8 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                                    jnp.asarray(it["idx_high"]),
                                    jnp.asarray(it["shift"]), sh)
         plane = state[n_lo:max_delay + 1]
+        if t_orig is not None and t_orig != t:
+            plane = plane[:, :t_orig]
         if not with_scores:
             return plane
         from .search import score_profiles
@@ -427,21 +456,12 @@ def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None):
     per channel along the track with band-crossing delay ``N``, anchored
     at the top of the band.
     """
-    import jax
     import jax.numpy as jnp
 
     data = jnp.asarray(data, dtype=jnp.float32)
-    nchan, t = data.shape
-    plan = fdmt_plan(nchan, float(start_freq), float(bandwidth),
-                     int(max_delay))
-
-    t_tile = _pick_fdmt_tile(t)
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu" and t_tile > 0
-    if use_pallas and t_tile == 0:
-        raise ValueError(
-            f"no power-of-two tile in [1024, 8192] divides T={t}; "
-            "pad the time axis or pass use_pallas=False")
+    nchan = data.shape[0]
+    data, t_run, t_tile, use_pallas, interpret, t_orig = _transform_setup(
+        data, use_pallas)
 
     # The whole transform runs as ONE jitted program: enqueueing the
     # merges eagerly allocates every intermediate state up-front (~4x the
@@ -449,8 +469,8 @@ def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None):
     # assignment inside a single program frees each state as soon as its
     # consumer has read it.
     run = _build_transform(nchan, float(start_freq), float(bandwidth),
-                           int(max_delay), t, t_tile, bool(use_pallas),
-                           jax.default_backend() != "tpu")
+                           int(max_delay), t_run, t_tile, use_pallas,
+                           interpret, t_orig=t_orig)
     return run(data)
 
 

@@ -229,25 +229,23 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     band-delay grid on ``[dmmin, dmmax]`` — see
     :func:`pulsarutils_tpu.ops.fdmt.fdmt_trial_dms`.
     """
-    import jax
     import jax.numpy as jnp
 
-    from .fdmt import _build_transform, _pick_fdmt_tile, fdmt_trial_dms
+    from .fdmt import _build_transform, _transform_setup, fdmt_trial_dms
 
     nchan = data.shape[0]
     trial_dms, n_lo, n_hi = fdmt_trial_dms(nchan, dmmin, dmmax, start_freq,
                                            bandwidth, sample_time)
     data = jnp.asarray(data, jnp.float32)
-    t = data.shape[1]
-    t_tile = _pick_fdmt_tile(t)
-    use_pallas = jax.default_backend() == "tpu" and t_tile > 0
+    data, t_run, t_tile, use_pallas, interpret, t_orig = _transform_setup(
+        data, None)
     # scoring (and the row slice) run inside the transform's jit: only
     # the per-trial score vectors (and optionally the plane) leave the
     # device, keeping back-to-back searches within HBM
     run = _build_transform(nchan, float(start_freq), float(bandwidth),
-                           n_hi, t, t_tile, use_pallas,
-                           jax.default_backend() != "tpu", n_lo=n_lo,
-                           with_scores=True, with_plane=capture_plane)
+                           n_hi, t_run, t_tile, use_pallas, interpret,
+                           n_lo=n_lo, with_scores=True,
+                           with_plane=capture_plane, t_orig=t_orig)
     out = run(data)
     maxvalues, stds, best_snrs, best_windows = (
         np.asarray(o) for o in out[:4])

@@ -1,0 +1,112 @@
+"""Multi-host (multi-process) execution: the DCN-scale analogue of the
+reference's single-process thread pool.
+
+The reference has no distributed backend at all — its only parallelism is
+numba ``prange`` threads (``pulsarutils/dedispersion.py:174-181``).  This
+module is the TPU-native scale-out path: one JAX process per host, the
+global device mesh laid so the channel-``psum`` rides ICI within a host
+while the embarrassingly-parallel DM axis spans hosts over DCN (trial
+shards never communicate, so DCN latency is irrelevant).
+
+Typical use on an N-host TPU pod slice::
+
+    from pulsarutils_tpu.parallel import multihost, sharded
+    multihost.initialize()                   # jax.distributed under the hood
+    mesh = multihost.pod_mesh()              # ("dm" over hosts, "chan" in-host)
+    table = sharded.sharded_dedispersion_search(array, ..., mesh=mesh)
+
+Every process must call :func:`initialize` before any other JAX API, run
+the same program, and feed the same (replicated) input — standard JAX SPMD
+multi-process semantics.  On a single host both functions degrade to the
+local equivalents, so the same driver script runs unchanged from a laptop
+CPU ("fake cluster" via ``--xla_force_host_platform_device_count``) to a
+pod slice.
+"""
+
+from __future__ import annotations
+
+from .mesh import make_mesh
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               **kwargs):
+    """Initialise JAX multi-process execution (idempotent).
+
+    Thin wrapper over ``jax.distributed.initialize``: with no arguments it
+    relies on the TPU pod's automatic environment discovery (the common
+    case on Cloud TPU); explicit coordinator/process arguments are for
+    manual clusters.  A single-process environment (no coordinator, one
+    host) is detected and left untouched, so calling this unconditionally
+    in driver scripts is safe.
+
+    Returns True when running multi-process, False when single-process.
+    """
+    import jax
+
+    if getattr(initialize, "_done", False):
+        return initialize._multi
+    if coordinator_address is not None or num_processes is not None:
+        # explicit cluster arguments: a failure here means one host of a
+        # REAL cluster would silently run standalone while its peers hang
+        # in collectives — propagate, and don't cache so a retry works
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id, **kwargs)
+        multi = True
+    else:
+        try:
+            # auto-discovery: succeeds on TPU pods (metadata-provided
+            # topology), raises / no-ops elsewhere — safe to swallow
+            jax.distributed.initialize()
+            multi = jax.process_count() > 1
+        except (ValueError, RuntimeError):
+            multi = False
+    initialize._done = True
+    initialize._multi = multi
+    return multi
+
+
+def pod_mesh(axis_names=("dm", "chan"), chan_per_host=None):
+    """A global (dm, chan) mesh for the sharded sweep on a pod slice.
+
+    Layout rule: the ``chan`` axis (which carries the per-block ``psum``)
+    stays INSIDE a host — its devices are ICI neighbours — while the
+    communication-free ``dm`` axis spans hosts over DCN.  With
+    ``jax.local_device_count() == L`` per host and ``P`` processes the
+    mesh is ``(P * L / chan, chan)`` with ``chan = chan_per_host or
+    largest power of two <= sqrt(L)``.
+
+    On one process this is just a local mesh — same code path.
+    """
+    import jax
+
+    local = jax.local_device_count()
+    if chan_per_host is None:
+        chan_per_host = 1
+        while chan_per_host * chan_per_host * 4 <= local:
+            chan_per_host *= 2
+    chan_per_host = max(1, min(chan_per_host, local))
+    ndev = len(jax.devices())
+    # jax.devices() orders devices process-major, so reshaping to
+    # (ndev // chan, chan) keeps each chan group within one host as long
+    # as chan_per_host divides the local device count
+    if local % chan_per_host:
+        raise ValueError(f"chan_per_host={chan_per_host} must divide the "
+                         f"local device count {local}")
+    return make_mesh((ndev // chan_per_host, chan_per_host), axis_names)
+
+
+def process_local_slice(n, axis_size=None, index=None):
+    """Host-local [start, stop) share of ``n`` items for data loading.
+
+    For feeding a multi-host run from per-host files/chunks: process ``i``
+    of ``P`` reads rows ``[i*n/P, (i+1)*n/P)``.  Single-process: the whole
+    range.
+    """
+    import jax
+
+    p = axis_size if axis_size is not None else jax.process_count()
+    i = index if index is not None else jax.process_index()
+    lo = (n * i) // p
+    hi = (n * (i + 1)) // p
+    return lo, hi

@@ -35,12 +35,61 @@ from ..pipeline.spectral_stats import get_bad_chans
 from ..utils.logging_utils import StageTimer, logger
 
 
+def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
+                          eff_tsamp, *, backend, kernel, capture_plane,
+                          state=None):
+    """One chunk's search with failure containment.
+
+    The reference has no failure handling at all (SURVEY §5).  Policy:
+
+    - configuration errors (ValueError/TypeError) propagate immediately —
+      they are deterministic and would fail identically on every chunk;
+    - a device-side failure (worker crash, wedged tunnel, OOM) is retried
+      once on the same backend, then the chunk falls back to the NumPy
+      reference path;
+    - the fallback decision is remembered in ``state`` (a mutable dict
+      shared across the chunk loop), so a persistently broken device is
+      discovered once — not re-discovered with two doomed attempts per
+      chunk — and every subsequent chunk runs on the same backend/kernel
+      (one consistent trial grid in the candidate store).
+    """
+    state = state if state is not None else {}
+    bk = state.get("backend", backend)
+    kern = state.get("kernel", kernel)
+    attempts = [(bk, kern), (bk, kern)]
+    if bk != "numpy":
+        attempts.append(("numpy", "auto"))
+    last = None
+    for i, (b, k) in enumerate(attempts):
+        try:
+            result = dedispersion_search(
+                array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
+                backend=b, kernel=k, capture_plane=capture_plane)
+            if (b, k) != (bk, kern):
+                logger.error(
+                    "device search failed persistently; the rest of this "
+                    "run uses backend=%s kernel=%s (reference path)", b, k)
+                state["backend"], state["kernel"] = b, k
+            return result
+        except (ValueError, TypeError):
+            raise  # deterministic configuration error
+        except Exception as exc:  # jax runtime errors share no base class
+            last = exc
+            if i + 1 < len(attempts):
+                nxt = attempts[i + 1]
+                logger.warning(
+                    "chunk search failed on backend=%s kernel=%s (%r); "
+                    "retrying with backend=%s kernel=%s", b, k, exc,
+                    nxt[0], nxt[1])
+    raise last
+
+
 def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      dmmin=200, dmmax=800, surelybad=(), *, backend="jax",
-                     snr_threshold=6.0, output_dir=None, make_plots="hits",
-                     resume=True, fft_zap=False, cut_outliers=False,
-                     max_chunks=None, progress=True, period_search=False,
-                     period_sigma_threshold=8.0):
+                     kernel="auto", snr_threshold=6.0, output_dir=None,
+                     make_plots="hits", resume=True, fft_zap=False,
+                     cut_outliers=False, max_chunks=None, progress=True,
+                     period_search=False, period_sigma_threshold=8.0):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -100,7 +149,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     fingerprint = config_fingerprint(
         fname=os.path.abspath(str(fname)), dmmin=dmmin, dmmax=dmmax,
         step=plan.step, resample=plan.resample, backend=backend,
-        snr_threshold=snr_threshold, fft_zap=fft_zap,
+        kernel=kernel, snr_threshold=snr_threshold, fft_zap=fft_zap,
         cut_outliers=cut_outliers, surelybad=sorted(int(c) for c in surelybad),
         period_search=bool(period_search),
         period_sigma_threshold=float(period_sigma_threshold))
@@ -109,6 +158,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     hits = []
     nproc = 0
     capture = bool(make_plots) or bool(period_search)
+    fallback_state = {}
     for istart in iter_chunk_starts(nsamples, plan, tmin=tmin,
                                     sample_time=sample_time):
         if max_chunks is not None and nproc >= max_chunks:
@@ -135,9 +185,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             istart=istart, pulse_freq=1.0 / (array.shape[1] * eff_tsamp))
 
         with with_timer("search"):
-            result = dedispersion_search(
+            result = _search_with_fallback(
                 array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
-                backend=backend, capture_plane=capture)
+                backend=backend, kernel=kernel, capture_plane=capture,
+                state=fallback_state)
         table, plane = result if capture else (result, None)
 
         best = table.best_row()

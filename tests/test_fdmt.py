@@ -124,6 +124,36 @@ class TestSearch:
             header["tsamp"], backend="jax", kernel="fdmt", show=True)
         assert plane.shape == (t_fd.nrows, array.shape[1])
 
+    def test_odd_length_time_axis(self):
+        # exercises the XLA-fallback / t_orig slicing for chunk lengths
+        # no power-of-two tile divides
+        array, header = simulate_test_data(150, nchan=32, nsamples=1900,
+                                           rng=11)
+        t_fd, plane = dedispersion_search(
+            array, 120, 180.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"], backend="jax", kernel="fdmt", show=True)
+        assert plane.shape == (t_fd.nrows, 1900)
+
+    def test_pipeline_accepts_fdmt_kernel(self, tmp_path):
+        from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+        from pulsarutils_tpu.models.simulate import disperse_array
+        from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+        rng = np.random.default_rng(12)
+        nchan, nsamples = 32, 8192
+        array = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+        array[:, 5000] += 4.0
+        array = disperse_array(array, 150, 1200., 200., 0.0005)
+        header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": 0.0005,
+                  "foff": 200. / nchan}
+        fname = str(tmp_path / "t.fil")
+        write_simulated_filterbank(fname, array, header, descending=True)
+        hits, store = search_by_chunks(
+            fname, dmmin=100, dmmax=200, backend="jax", kernel="fdmt",
+            make_plots=False, output_dir=str(tmp_path))
+        assert any(abs(info.dm - 150) < 5 for _, _, info, _ in hits)
+
     def test_fdmt_requires_jax_backend(self):
         array, header = simulate_test_data(150, nchan=16, nsamples=512,
                                            rng=9)

@@ -193,3 +193,40 @@ def test_sharded_search_pallas_kernel_matches_numpy():
     np.testing.assert_allclose(np.asarray(t_pl["snr"]),
                                np.asarray(t_ref["snr"]), rtol=2e-3,
                                atol=2e-3)
+
+
+class TestMultihost:
+    """Single-process degradation of the multi-host helpers (the real
+    multi-process path shares every code line except jax.distributed
+    bring-up, which needs actual multiple hosts)."""
+
+    def test_initialize_single_process_is_safe_and_idempotent(self):
+        from pulsarutils_tpu.parallel import multihost
+
+        assert multihost.initialize() is False
+        assert multihost.initialize() is False  # cached, no re-init
+
+    def test_pod_mesh_on_fake_cluster(self):
+        from pulsarutils_tpu.parallel import multihost
+        from pulsarutils_tpu.parallel.sharded import (
+            sharded_dedispersion_search,
+        )
+
+        mesh = multihost.pod_mesh()
+        assert set(mesh.axis_names) == {"dm", "chan"}
+        assert mesh.devices.size == 8  # conftest's virtual CPU devices
+        array, header = simulate_test_data(150, nchan=16, nsamples=512,
+                                           rng=21)
+        t = sharded_dedispersion_search(
+            array, 100, 200., header["fbottom"], header["bandwidth"],
+            header["tsamp"], mesh=mesh)
+        assert abs(float(t["DM"][t.argbest()]) - 150) < 2
+
+    def test_process_local_slice_partitions_exactly(self):
+        from pulsarutils_tpu.parallel.multihost import process_local_slice
+
+        n, p = 103, 4
+        spans = [process_local_slice(n, p, i) for i in range(p)]
+        assert spans[0][0] == 0 and spans[-1][1] == n
+        for (a, b), (c, d) in zip(spans, spans[1:]):
+            assert b == c

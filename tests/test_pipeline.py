@@ -288,3 +288,26 @@ def test_search_by_chunks_period_search(pulsar_file, tmp_path):
     loaded, _ = store.load_candidate(*cands[0])
     assert loaded.period_freq == pytest.approx(info.period_freq)
     assert loaded.fold_profile is not None
+
+
+def test_search_fallback_survives_device_failure(monkeypatch):
+    """A device-side failure on a chunk degrades to the NumPy reference
+    path instead of killing a long streaming search."""
+    from pulsarutils_tpu.pipeline import search_pipeline as sp
+
+    array, header = simulate_test_data(150, nchan=16, nsamples=1024, rng=33)
+    real = sp.dedispersion_search
+    calls = []
+
+    def flaky(data, *args, backend="numpy", **kw):
+        calls.append(backend)
+        if backend == "jax":
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake TPU crash")
+        return real(data, *args, backend=backend, **kw)
+
+    monkeypatch.setattr(sp, "dedispersion_search", flaky)
+    table = sp._search_with_fallback(
+        array, 100, 200., header["fbottom"], header["bandwidth"],
+        header["tsamp"], backend="jax", kernel="auto", capture_plane=False)
+    assert calls == ["jax", "jax", "numpy"]
+    assert abs(float(table["DM"][table.argbest()]) - 150) < 2
