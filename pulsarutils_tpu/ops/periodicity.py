@@ -328,10 +328,16 @@ def refine_grid(freq, tsamp, nsamples, oversample=8, half_width_bins=2):
 # ---------------------------------------------------------------------------
 
 def period_search_plane(plane, tsamp, max_harmonics=16, fmin=None, fmax=None,
-                        nbin=32, oversample=8, refine_top=1, xp=np):
+                        nbin=32, oversample=8, refine_top=1, row_chunk=None,
+                        xp=np):
     """Folded period search over a dedispersed plane ``(ndm, T)``.
 
-    Stage 1 (device): batched FFT + harmonic-sum search per DM trial.
+    Stage 1 (device): batched FFT + harmonic-sum search per DM trial,
+    processed ``row_chunk`` rows at a time — XLA's batched rFFT allocates
+    several (rows x T) temporaries, so an unchunked 4096-trial x 256k
+    plane overruns HBM.  Default keeps each chunk's FFT workspace near
+    0.5 GB.  Per-row results concatenate exactly, so chunking changes
+    nothing numerically.
     Stage 2 (device): for the ``refine_top`` most significant DM rows, fold
     on a fine frequency grid around the spectral candidate and H-test.
 
@@ -343,8 +349,23 @@ def period_search_plane(plane, tsamp, max_harmonics=16, fmin=None, fmax=None,
     """
     plane = xp.asarray(plane)
     ndm, t = plane.shape
-    spec = spectral_search(plane, tsamp, max_harmonics=max_harmonics,
-                           fmin=fmin, fmax=fmax, xp=xp)
+    if row_chunk is None:
+        row_chunk = max(16, (1 << 27) // max(1, t))
+    if ndm <= row_chunk:
+        spec = spectral_search(plane, tsamp, max_harmonics=max_harmonics,
+                               fmin=fmin, fmax=fmax, xp=xp)
+    else:
+        chunks = []
+        for lo in range(0, ndm, row_chunk):
+            c = spectral_search(plane[lo:lo + row_chunk], tsamp,
+                                max_harmonics=max_harmonics, fmin=fmin,
+                                fmax=fmax, xp=xp)
+            # pull to host INSIDE the loop: async dispatch would otherwise
+            # run several chunks' FFT workspaces concurrently in HBM —
+            # the very blow-up the chunking exists to prevent
+            chunks.append({k: np.asarray(v) for k, v in c.items()})
+        spec = {k: np.concatenate([c[k] for c in chunks])
+                for k in chunks[0]}
 
     order = np.argsort(np.asarray(spec["log_sf"]))
     best = {}

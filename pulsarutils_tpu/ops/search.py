@@ -214,10 +214,22 @@ def _search_jax_pallas(data, offsets, capture_plane, dm_block=None,
                                         chan_block=chan_block)
         outs.append([np.asarray(o) for o in scorer(plane)])
         if capture_plane:
-            planes.append(np.asarray(plane))
+            # single superblock: keep the plane device-resident so
+            # downstream consumers (plane period search, diagnostics)
+            # pull only what they need over the slow host link.  Multiple
+            # superblocks: spill each to host as it completes — device
+            # concatenation would hold all blocks plus the result (2x the
+            # full plane) in HBM, breaking the PALLAS_SUPERBLOCK bound.
+            planes.append(plane if ndm <= PALLAS_SUPERBLOCK
+                          else np.asarray(plane))
     maxvalues, stds, best_snrs, best_windows = (
         np.concatenate([o[i] for o in outs]) for i in range(4))
-    plane = np.concatenate(planes) if capture_plane else None
+    if not capture_plane:
+        plane = None
+    elif len(planes) == 1:
+        plane = planes[0]
+    else:
+        plane = np.concatenate(planes)
     return maxvalues, stds, best_snrs, best_windows, plane
 
 
@@ -249,7 +261,7 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     out = run(data)
     maxvalues, stds, best_snrs, best_windows = (
         np.asarray(o) for o in out[:4])
-    plane_out = np.asarray(out[4]) if capture_plane else None
+    plane_out = out[4] if capture_plane else None  # device-resident
     return trial_dms, maxvalues, stds, best_snrs, best_windows, plane_out
 
 
@@ -290,11 +302,14 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
     gather_kernel = _jax_search_kernel(capture_plane, chan_block)
     out = gather_kernel(data, jnp.asarray(offset_blocks))
-    out = [np.asarray(o).reshape(-1, *o.shape[2:])[:ndm] for o in out]
-    if capture_plane:
-        maxvalues, stds, best_snrs, best_windows, plane = out
+    scores = [np.asarray(o).reshape(-1, *o.shape[2:])[:ndm]
+              for o in out[:4]]
+    maxvalues, stds, best_snrs, best_windows = scores
+    if capture_plane:  # keep device-resident (see _search_jax_pallas)
+        plane = out[4].reshape(-1, *out[4].shape[2:])
+        if plane.shape[0] != ndm:  # slicing outside jit is a real copy
+            plane = plane[:ndm]
     else:
-        maxvalues, stds, best_snrs, best_windows = out
         plane = None
     return maxvalues, stds, best_snrs, best_windows, plane
 
