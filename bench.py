@@ -39,7 +39,11 @@ def make_data(nchan, nsamp, start_freq, bandwidth, tsamp, inject_dm, seed=0):
 
     rng = np.random.default_rng(seed)
     log(f"simulating {nchan} x {nsamp} filterbank ...")
-    array = np.abs(rng.standard_normal((nchan, nsamp), dtype=np.float32)) * 0.5
+    # in place: the full config is a 4-19 GB array on a 1-core host —
+    # np.abs(...) * 0.5 would allocate two extra copies
+    array = rng.standard_normal((nchan, nsamp), dtype=np.float32)
+    np.abs(array, out=array)
+    array *= 0.5
     array[:, nsamp // 2] += 1.0
     # disperse: per-channel circular roll (fast host path)
     shifts = np.rint(np.asarray(dedispersion_shifts(

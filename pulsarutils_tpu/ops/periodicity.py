@@ -347,17 +347,21 @@ def period_search_plane(plane, tsamp, max_harmonics=16, fmin=None, fmax=None,
     the de Jager & Büsching 2010 tail ``P(>H) ~ exp(-0.4 H)``) and
     ``best_profile``.
     """
-    plane = xp.asarray(plane)
+    # NOTE: do not blanket-convert ``plane`` with xp.asarray — a plane the
+    # search spilled to host (ndm beyond one superblock) would be shipped
+    # back to HBM whole, defeating the chunked memory bound below; chunks
+    # are converted as they are processed
     ndm, t = plane.shape
     if row_chunk is None:
         row_chunk = max(16, (1 << 27) // max(1, t))
     if ndm <= row_chunk:
-        spec = spectral_search(plane, tsamp, max_harmonics=max_harmonics,
+        spec = spectral_search(xp.asarray(plane), tsamp,
+                               max_harmonics=max_harmonics,
                                fmin=fmin, fmax=fmax, xp=xp)
     else:
         chunks = []
         for lo in range(0, ndm, row_chunk):
-            c = spectral_search(plane[lo:lo + row_chunk], tsamp,
+            c = spectral_search(xp.asarray(plane[lo:lo + row_chunk]), tsamp,
                                 max_harmonics=max_harmonics, fmin=fmin,
                                 fmax=fmax, xp=xp)
             # pull to host INSIDE the loop: async dispatch would otherwise

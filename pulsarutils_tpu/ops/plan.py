@@ -117,6 +117,9 @@ def normalize_shifts(shifts, n, xp=np):
     repeatedly adding/subtracting ``n`` is exactly the mathematical modulo,
     which both NumPy's and JAX's ``%`` implement for the int32 values
     produced by ``rint``.
+
+    >>> normalize_shifts(np.array([-1.2, 0.0, 3.6, 10.0]), 8)
+    array([7, 0, 4, 2], dtype=int32)
     """
     shifts = xp.asarray(shifts)
     # float modulo is exact for the integer-valued magnitudes produced here
@@ -134,6 +137,17 @@ def dedispersion_plan(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time,
     between consecutive trials.  ``trial_N = arange(min_N, max_N + 1)`` is
     then inverted to DM.  (The reference's ``np.float`` calls — removed from
     NumPy >= 1.24 — are simply dropped; values are already floats.)
+
+    The endpoints bracket the requested range and consecutive trials differ
+    by one sample of band delay:
+
+    >>> dms = dedispersion_plan(64, 100, 200.0, 1200.0, 200.0, 0.0005)
+    >>> bool(dms[0] <= 100.5) and bool(dms[-1] >= 199.0)
+    True
+    >>> d = (delta_delay(dms[1], 1200.0, 1400.0)
+    ...      - delta_delay(dms[0], 1200.0, 1400.0)) / 0.0005
+    >>> round(float(d), 6)
+    1.0
     """
     stop_freq = start_freq + bandwidth
     f0 = float(start_freq)

@@ -17,7 +17,15 @@ import numpy as np
 def quick_chan_rebin(counts, factor, xp=np):
     """Rebin along the **channel** (first) axis by an integer factor.
 
-    Reference: ``pulsarutils/dedispersion.py:15-35``.
+    Reference: ``pulsarutils/dedispersion.py:15-35``.  Trailing channels
+    that do not fill a block are truncated:
+
+    >>> quick_chan_rebin(np.ones((5, 3)), 2)
+    array([[2., 2., 2.],
+           [2., 2., 2.]])
+    >>> quick_chan_rebin(np.arange(8).reshape(4, 2), 2)
+    array([[ 2,  4],
+           [10, 12]])
     """
     nchan, nbin = counts.shape
     n = int(nchan // factor)
@@ -31,6 +39,12 @@ def quick_resample(counts, factor, xp=np):
     (``pulsarutils/dedispersion.py:38-57``).  Works on 1-D or 2-D input
     (the reference requires 2-D; 1-D is accepted here for convenience and
     treated as a single channel).
+
+    >>> quick_resample(np.ones((2, 6)), 3)
+    array([[3., 3.],
+           [3., 3.]])
+    >>> quick_resample(np.arange(5.0), 2)  # trailing sample truncated
+    array([1., 5.])
     """
     counts = xp.asarray(counts)
     squeeze = counts.ndim == 1
