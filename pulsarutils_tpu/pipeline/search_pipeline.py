@@ -159,96 +159,156 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     nproc = 0
     capture = bool(make_plots) or bool(period_search)
     fallback_state = {}
-    for istart in iter_chunk_starts(nsamples, plan, tmin=tmin,
-                                    sample_time=sample_time):
-        if max_chunks is not None and nproc >= max_chunks:
-            break
-        if resume and store.is_done(istart):
-            continue
-        chunk_size = min(plan.step, nsamples - istart)
-        iend = istart + chunk_size
-        t0 = istart * sample_time
 
-        with with_timer("read"):
-            array = reader.read_block(istart, chunk_size, band_ascending=True)
-        with with_timer("clean"):
-            array = renormalize_data(array, badchans_mask=mask,
-                                     cut_outliers=cut_outliers)
-            if fft_zap:
-                array, _ = fft_zap_time(array)
+    # one conditioning pipeline, parameterised by array namespace — the
+    # device (jitted) and host (fallback) paths must never diverge
+    def _clean(block, m, xp=np):
+        cleaned = renormalize_data(block, badchans_mask=m,
+                                   cut_outliers=cut_outliers, xp=xp)
+        if fft_zap:
+            cleaned, _ = fft_zap_time(cleaned, xp=xp)
         if plan.resample > 1:
-            array = quick_resample(array, plan.resample)
+            cleaned = quick_resample(cleaned, plan.resample, xp=xp)
+        return cleaned
 
-        info = PulseInfo(
-            allprofs=array, start_freq=start_freq, bandwidth=bandwidth,
-            nbin=array.shape[1], nchan=array.shape[0], date=date, t0=t0,
-            istart=istart, pulse_freq=1.0 / (array.shape[1] * eff_tsamp))
+    # device-side cleaning: with backend="jax" the chunk is uploaded raw
+    # and conditioned on the accelerator (one jitted program reused for
+    # every chunk) — the host, often a single core, only reads/decodes,
+    # and the cleaned chunk is already device-resident for the search
+    device_clean = None
+    if backend == "jax":
+        import functools
 
-        with with_timer("search"):
-            result = _search_with_fallback(
-                array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
-                backend=backend, kernel=kernel, capture_plane=capture,
-                state=fallback_state)
-        table, plane = result if capture else (result, None)
+        import jax
+        import jax.numpy as jnp
 
-        best = table.best_row()
-        is_hit = bool(best["snr"] > snr_threshold)
+        mask_dev = jnp.asarray(np.asarray(mask))
+        device_clean = jax.jit(functools.partial(_clean, xp=jnp))
 
-        if period_search and plane is not None:
-            from ..ops.periodicity import period_search_plane
+    # the chunk list is known upfront, so the NEXT chunk's read/decode
+    # overlaps the current chunk's device compute (single reader thread —
+    # the driver host is often one core doing nothing during the search)
+    todo = [s for s in iter_chunk_starts(nsamples, plan, tmin=tmin,
+                                         sample_time=sample_time)
+            if not (resume and store.is_done(s))]
+    if max_chunks is not None:
+        todo = todo[:max_chunks]
 
-            if backend == "jax":
-                import jax.numpy as _xp
-            else:
-                _xp = np
-            with with_timer("period"):
-                pres = period_search_plane(
-                    _xp.asarray(plane), eff_tsamp,
-                    fmin=4.0 / (plane.shape[1] * eff_tsamp), refine_top=1,
-                    xp=_xp)
-            if pres["best_sigma"] > period_sigma_threshold:
-                info.period_freq = float(pres["best_freq"])
-                info.period_dm = float(table["DM"][pres["best_dm_index"]])
-                info.period_sigma = float(pres["best_sigma"])
-                info.period_H = float(pres["best_h"])
-                info.period_M = int(pres["best_m"])
-                if pres["best_profile"] is not None:
-                    info.fold_profile = np.asarray(pres["best_profile"])
-                is_hit = True
-                logger.info("PERIODIC chunk %d-%d: f=%.4f Hz DM=%.2f "
-                            "sigma=%.1f", istart, iend, info.period_freq,
-                            info.period_dm, info.period_sigma)
+    from concurrent.futures import ThreadPoolExecutor
 
-        if is_hit:
-            info.dm = float(best["DM"])
-            info.snr = float(best["snr"])
-            info.width = float(best["rebin"]) * eff_tsamp
-            info.disp_profile = array.mean(0)
-            if plane is not None:
-                info.dedisp_profile = np.asarray(plane[table.argbest()])
-            info.compute_stats()
-            with with_timer("persist"):
-                store.save_candidate(root, istart, iend, info, table)
-            hits.append((istart, iend, info, table))
-            logger.info("HIT chunk %d-%d: DM=%.2f snr=%.2f width=%gs",
-                        istart, iend, info.dm, info.snr, info.width)
+    def read_at(s):
+        return reader.read_block(s, min(plan.step, nsamples - s),
+                                 band_ascending=True)
 
-        if make_plots == "all" or (make_plots == "hits" and is_hit):
-            from .diagnostics import plot_diagnostics
+    reader_pool = ThreadPoolExecutor(max_workers=1)
+    next_read = reader_pool.submit(read_at, todo[0]) if todo else None
+    try:
+        for ichunk, istart in enumerate(todo):
+            chunk_size = min(plan.step, nsamples - istart)
+            iend = istart + chunk_size
+            t0 = istart * sample_time
 
-            with with_timer("plot"):
-                plot_diagnostics(
-                    info, table, plane,
-                    outname=os.path.join(output_dir,
-                                         f"{root}_{istart}-{iend}.jpg"),
-                    t0=t0)
+            with with_timer("read"):
+                array = next_read.result()
+            next_read = (reader_pool.submit(read_at, todo[ichunk + 1])
+                         if ichunk + 1 < len(todo) else None)
+            with with_timer("clean"):
+                if device_clean is not None:
+                    try:
+                        array = device_clean(jnp.asarray(array), mask_dev)
+                        # force: dispatch is async, so a device failure
+                        # would otherwise surface as a poisoned array
+                        # later, past both fallbacks (block_until_ready
+                        # is unreliable on tunnelled platforms — read
+                        # one element instead)
+                        np.asarray(array[0, :1])
+                    except Exception as exc:
+                        logger.warning("device clean failed (%r); cleaning "
+                                       "on host from here on", exc)
+                        device_clean = None
+                if device_clean is None:
+                    array = _clean(np.asarray(array), mask)
 
-        store.mark_done(istart)
-        nproc += 1
-        if progress and nproc % 50 == 0:
-            logger.info("processed %d chunks (through sample %d/%d)",
-                        nproc, iend, nsamples)
+            info = PulseInfo(
+                allprofs=array, start_freq=start_freq, bandwidth=bandwidth,
+                nbin=array.shape[1], nchan=array.shape[0], date=date, t0=t0,
+                istart=istart,
+                pulse_freq=1.0 / (array.shape[1] * eff_tsamp))
 
+            with with_timer("search"):
+                result = _search_with_fallback(
+                    array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
+                    backend=backend, kernel=kernel, capture_plane=capture,
+                    state=fallback_state)
+            table, plane = result if capture else (result, None)
+
+            best = table.best_row()
+            is_hit = bool(best["snr"] > snr_threshold)
+
+            if period_search and plane is not None:
+                from ..ops.periodicity import period_search_plane
+
+                if backend == "jax":
+                    import jax.numpy as _xp
+                else:
+                    _xp = np
+                with with_timer("period"):
+                    pres = period_search_plane(
+                        plane, eff_tsamp,
+                        fmin=4.0 / (plane.shape[1] * eff_tsamp),
+                        refine_top=1, xp=_xp)
+                if pres["best_sigma"] > period_sigma_threshold:
+                    info.period_freq = float(pres["best_freq"])
+                    info.period_dm = float(
+                        table["DM"][pres["best_dm_index"]])
+                    info.period_sigma = float(pres["best_sigma"])
+                    info.period_H = float(pres["best_h"])
+                    info.period_M = int(pres["best_m"])
+                    if pres["best_profile"] is not None:
+                        info.fold_profile = np.asarray(pres["best_profile"])
+                    is_hit = True
+                    logger.info("PERIODIC chunk %d-%d: f=%.4f Hz DM=%.2f "
+                                "sigma=%.1f", istart, iend,
+                                info.period_freq, info.period_dm,
+                                info.period_sigma)
+
+            if is_hit:
+                # retained across the whole run (hits list): convert any
+                # device-resident arrays to host now, or every hit pins
+                # tens of MB of HBM until the search ends
+                info.allprofs = np.asarray(info.allprofs)
+                info.dm = float(best["DM"])
+                info.snr = float(best["snr"])
+                info.width = float(best["rebin"]) * eff_tsamp
+                info.disp_profile = np.asarray(array.mean(0))
+                if plane is not None:
+                    info.dedisp_profile = np.asarray(plane[table.argbest()])
+                info.compute_stats()
+                with with_timer("persist"):
+                    store.save_candidate(root, istart, iend, info, table)
+                hits.append((istart, iend, info, table))
+                logger.info("HIT chunk %d-%d: DM=%.2f snr=%.2f width=%gs",
+                            istart, iend, info.dm, info.snr, info.width)
+
+            if make_plots == "all" or (make_plots == "hits" and is_hit):
+                from .diagnostics import plot_diagnostics
+
+                with with_timer("plot"):
+                    plot_diagnostics(
+                        info, table, plane,
+                        outname=os.path.join(output_dir,
+                                             f"{root}_{istart}-{iend}.jpg"),
+                        t0=t0)
+
+            store.mark_done(istart)
+            nproc += 1
+            if progress and nproc % 50 == 0:
+                logger.info("processed %d chunks (through sample %d/%d)",
+                            nproc, iend, nsamples)
+    except BaseException:
+        reader_pool.shutdown(wait=False, cancel_futures=True)
+        raise
+    reader_pool.shutdown(wait=True)
     timer.report()
     logger.info("done: %d chunks processed, %d hits", nproc, len(hits))
     return hits, store
