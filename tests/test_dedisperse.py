@@ -25,6 +25,27 @@ def test_roll_and_sum_doctest():
     assert sum_array is roll_and_sum(array, sum_array, 3)
 
 
+def test_roll_and_sum_out_of_range_n():
+    # the slice-add form must agree with np.roll for negative and
+    # wrapped-past-length shifts, and accumulate (not overwrite)
+    array = np.arange(11.0)
+    for n in (-3, -11, 0, 11, 14, 25):
+        acc = np.ones(11)
+        roll_and_sum(array, acc, n)
+        assert np.allclose(acc, 1.0 + np.roll(array, n)), n
+
+
+def test_batch_numpy_out_param():
+    rng = np.random.default_rng(7)
+    data = rng.normal(size=(8, 100))  # non-power-of-two T exercises wraps
+    dms = np.linspace(50, 150, 5)
+    shifts = dedispersion_shifts_batch(dms, 8, 1200., 200., 0.0005)
+    out = np.full((5, 100), 1e9)  # stale contents must be overwritten
+    got = dedisperse_batch_numpy(data, shifts, out=out)
+    assert got is out
+    assert np.allclose(out, dedisperse_batch_numpy(data, shifts))
+
+
 def test_dedisperse_undoes_simulated_dispersion():
     rng = np.random.default_rng(1)
     nchan, t = 16, 256
