@@ -174,6 +174,12 @@ def main():
         pass
 
     try:
+        try:  # claim flaps for ~a minute after another process releases
+            from tools.tpu_claim import claim_tpu
+
+            claim_tpu(retries=6, sleep_s=20, log=log)
+        except ImportError:
+            pass
         platform = jax.devices()[0].platform
     except RuntimeError as exc:
         log(f"accelerator init failed ({exc}); falling back to CPU")

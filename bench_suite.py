@@ -31,12 +31,12 @@ def emit(obj):
     print(json.dumps(obj), flush=True)
 
 
-GEOM = (1200.0, 200.0, 0.0005)  # start_freq, bandwidth, tsamp
+# geometry/injected-DM single source of truth: bench.py's constants (the
+# simulated dispersion and the suite's searches must share one geometry)
+from bench import GEOM  # noqa: E402
 
 
 def simulate(nchan, nsamp, seed=0):
-    # single source of truth for the benchmark's injected-signal model
-    # (geometry and injected DM are bench.py module constants)
     import bench
 
     return bench.make_data(nchan, nsamp, seed=seed)

@@ -65,6 +65,26 @@ def score_profiles(plane, xp=np):
     return maxvalues, stds, best_snrs, best_windows
 
 
+def score_profiles_stacked(plane, xp=np):
+    """:func:`score_profiles` packed into ONE ``(4, ndm)`` float array.
+
+    The tunnelled-TPU transfer layer pays a full round trip per array
+    fetched; stacking the four per-trial score vectors device-side makes
+    the whole search's host readback a single transfer.  Row order:
+    ``max, std, snr, window`` (windows are 1..8 — exact in float32).
+    """
+    maxvalues, stds, best_snrs, best_windows = score_profiles(plane, xp=xp)
+    return xp.stack([maxvalues, stds, best_snrs,
+                     best_windows.astype(maxvalues.dtype)])
+
+
+def unstack_scores(stacked):
+    """Host-side inverse of :func:`score_profiles_stacked` (one readback)."""
+    stacked = np.asarray(stacked)
+    maxvalues, stds, best_snrs, wins = stacked
+    return maxvalues, stds, best_snrs, np.rint(wins).astype(np.int32)
+
+
 #: soft cap on the gather workspace (elements) a single trial-block may
 #: materialise; keeps the kernel HBM-resident at 1M-sample configs
 GATHER_BUDGET_ELEMENTS = 1 << 28
@@ -152,19 +172,20 @@ def search_kernel_fn(data, offset_blocks, capture_plane=False,
 
     ``data`` is ``(nchan, T)``; ``offset_blocks`` is
     ``(nblocks, dm_block, nchan)`` int32 gather offsets.  Returns the
-    per-block score arrays (and the dedispersed plane blocks when
-    ``capture_plane``).  Traceable under ``jit``/``shard_map``; the blocks
-    are processed by ``lax.map`` so the compiled program is independent of
-    the trial count.
+    per-block stacked scores ``(nblocks, 4, dm_block)`` (see
+    :func:`score_profiles_stacked`) — plus the dedispersed plane blocks
+    when ``capture_plane``.  Traceable under ``jit``/``shard_map``; the
+    blocks are processed by ``lax.map`` so the compiled program is
+    independent of the trial count.
     """
     import jax
     import jax.numpy as jnp
 
     def per_block(offs):
         plane = dedisperse_block_chunked_jax(data, offs, chan_block)
-        scores = score_profiles(plane, xp=jnp)
+        scores = score_profiles_stacked(plane, xp=jnp)
         if capture_plane:
-            return scores + (plane,)
+            return scores, plane
         return scores
 
     return jax.lax.map(per_block, offset_blocks)
@@ -195,7 +216,7 @@ def _jitted_scorer():
 
     @jax.jit
     def score(plane):
-        return score_profiles(plane, xp=jnp)
+        return score_profiles_stacked(plane, xp=jnp)
 
     return score
 
@@ -212,7 +233,7 @@ def _search_jax_pallas(data, offsets, capture_plane, dm_block=None,
         sub = offsets[lo:lo + PALLAS_SUPERBLOCK]
         plane = dedisperse_plane_pallas(data, sub, dm_block=dm_block,
                                         chan_block=chan_block)
-        outs.append([np.asarray(o) for o in scorer(plane)])
+        outs.append(unstack_scores(scorer(plane)))  # one readback
         if capture_plane:
             # single superblock: keep the plane device-resident so
             # downstream consumers (plane period search, diagnostics)
@@ -259,9 +280,11 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                            n_lo=n_lo, with_scores=True,
                            with_plane=capture_plane, t_orig=t_orig)
     out = run(data)
-    maxvalues, stds, best_snrs, best_windows = (
-        np.asarray(o) for o in out[:4])
-    plane_out = out[4] if capture_plane else None  # device-resident
+    if capture_plane:
+        stacked, plane_out = out  # plane stays device-resident
+    else:
+        stacked, plane_out = out, None
+    maxvalues, stds, best_snrs, best_windows = unstack_scores(stacked)
     return trial_dms, maxvalues, stds, best_snrs, best_windows, plane_out
 
 
@@ -302,11 +325,11 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
     gather_kernel = _jax_search_kernel(capture_plane, chan_block)
     out = gather_kernel(data, jnp.asarray(offset_blocks))
-    scores = [np.asarray(o).reshape(-1, *o.shape[2:])[:ndm]
-              for o in out[:4]]
-    maxvalues, stds, best_snrs, best_windows = scores
+    stacked = out[0] if capture_plane else out  # (nblocks, 4, dm_block)
+    stacked = np.asarray(stacked).transpose(1, 0, 2).reshape(4, -1)[:, :ndm]
+    maxvalues, stds, best_snrs, best_windows = unstack_scores(stacked)
     if capture_plane:  # keep device-resident (see _search_jax_pallas)
-        plane = out[4].reshape(-1, *out[4].shape[2:])
+        plane = out[1].reshape(-1, *out[1].shape[2:])
         if plane.shape[0] != ndm:  # slicing outside jit is a real copy
             plane = plane[:ndm]
     else:

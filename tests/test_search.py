@@ -119,3 +119,23 @@ def test_score_profiles_reference_semantics():
         assert stds[i] == pytest.approx(s)
         assert snr[i] == pytest.approx(b)
         assert win[i] == w
+
+
+def test_score_profiles_stacked_round_trip():
+    from pulsarutils_tpu.ops.search import (
+        score_profiles,
+        score_profiles_stacked,
+        unstack_scores,
+    )
+
+    rng = np.random.default_rng(9)
+    profiles = rng.normal(size=(7, 96)).astype(np.float32)
+    profiles[3, 10] += 9.0
+    stacked = score_profiles_stacked(profiles)
+    assert stacked.shape == (4, 7)
+    maxv, stds, snr, win = unstack_scores(stacked)
+    m0, s0, b0, w0 = score_profiles(profiles)
+    assert np.allclose(maxv, m0)
+    assert np.allclose(stds, s0)
+    assert np.allclose(snr, b0)
+    assert win.dtype == np.int32 and np.array_equal(win, w0)

@@ -6,6 +6,7 @@ Generates the data ON DEVICE (no host upload — the tunnel is slow and this
 probe measures kernel time, not link bandwidth), warms each kernel once,
 then reports steady-state seconds and DM-trials/s.
 """
+import os
 import sys
 import time
 
@@ -18,6 +19,11 @@ def main(argv):
     ndm = int(argv[3]) if len(argv) > 3 else 512
     kernels = argv[4:] or ["fdmt", "pallas"]
 
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.tpu_claim import claim_tpu
+
+    claim_tpu()
     import jax
     import jax.numpy as jnp
 
