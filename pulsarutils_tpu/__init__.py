@@ -100,6 +100,8 @@ def __getattr__(name):
                                "get_spectral_stats"),
         "PulseInfo": ("pipeline.pulse_info", "PulseInfo"),
         "plot_diagnostics": ("pipeline.diagnostics", "plot_diagnostics"),
+        "sift_hits": ("pipeline.sift", "sift_hits"),
+        "sift_candidates": ("pipeline.sift", "sift_candidates"),
         "FilterbankReader": ("io.sigproc", "FilterbankReader"),
         "FilterbankWriter": ("io.sigproc", "FilterbankWriter"),
         "write_filterbank": ("io.sigproc", "write_filterbank"),

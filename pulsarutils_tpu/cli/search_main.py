@@ -50,12 +50,16 @@ def build_parser():
                              "chunk's dedispersed plane")
     parser.add_argument("--period-sigma", type=float, default=8.0,
                         help="significance threshold for periodic hits")
+    parser.add_argument("--no-sift", action="store_true",
+                        help="skip duplicate-candidate sifting (the 50%% "
+                             "chunk overlap detects each pulse twice)")
     return parser
 
 
 def main(args=None):
     opts = build_parser().parse_args(args)
-    total_hits = 0
+    total_raw = 0
+    total_cands = 0
     for fname in opts.fnames:
         hits, _ = search_by_chunks(
             fname,
@@ -77,6 +81,20 @@ def main(args=None):
             period_search=opts.period_search,
             period_sigma_threshold=opts.period_sigma,
         )
-        total_hits += len(hits)
-    logger.info("total candidates: %d", total_hits)
+        total_raw += len(hits)
+        if hits and not opts.no_sift:
+            from ..pipeline.sift import sift_hits
+
+            sifted = sift_hits(hits)
+            total_cands += len(sifted)
+            logger.info("%s: %d raw detections -> %d sifted candidates",
+                        fname, len(hits), len(sifted))
+            for c in sifted:
+                logger.info("  t=%.4fs DM=%.2f snr=%.2f width=%.4gs "
+                            "(%d detections)", c["time"], c["dm"], c["snr"],
+                            c["width"], c["n_members"])
+        else:
+            total_cands += len(hits)
+    logger.info("total candidates: %d (%d raw detections)",
+                total_cands, total_raw)
     return 0

@@ -131,16 +131,28 @@ class FilterbankReader:
         data_size = os.path.getsize(path) - offset
         self.header = derived_header(raw_header, data_size)
         nbits = self.header.get("nbits", 32)
-        if nbits not in _DTYPES:
-            raise ValueError(f"unsupported nbits={nbits}")
-        self._dtype = _DTYPES[nbits]
+        self._nbits = nbits
         nifs = self.header.get("nifs", 1)
         if nifs != 1:
             raise NotImplementedError("nifs > 1 not supported")
-        self._mmap = np.memmap(path, dtype=self._dtype, mode="r",
-                               offset=offset,
-                               shape=(self.header["nsamples"],
-                                      self.header["nchans"]))
+        nchans = self.header["nchans"]
+        if nbits in (1, 2, 4):
+            # packed low-bit samples: mmap the raw bytes, unpack per block
+            # (native C loop when available — io/lowbit.py)
+            if (nchans * nbits) % 8:
+                raise ValueError(
+                    f"nchans={nchans} at nbits={nbits} does not pack to "
+                    "whole bytes")
+            self._mmap = np.memmap(
+                path, dtype=np.uint8, mode="r", offset=offset,
+                shape=(self.header["nsamples"], nchans * nbits // 8))
+        elif nbits in _DTYPES:
+            self._dtype = _DTYPES[nbits]
+            self._mmap = np.memmap(path, dtype=self._dtype, mode="r",
+                                   offset=offset,
+                                   shape=(self.header["nsamples"], nchans))
+        else:
+            raise ValueError(f"unsupported nbits={nbits}")
 
     @property
     def nsamples(self):
@@ -157,7 +169,14 @@ class FilterbankReader:
     def read_block(self, istart, nsamps, band_ascending=False):
         istart = int(istart)
         nsamps = int(min(nsamps, self.nsamples - istart))
-        block = np.asarray(self._mmap[istart:istart + nsamps]).T.astype(float)
+        raw = np.asarray(self._mmap[istart:istart + nsamps])
+        if self._nbits in (1, 2, 4):
+            from .lowbit import unpack
+
+            block = unpack(raw, self._nbits).reshape(
+                nsamps, self.nchans).T.astype(float)
+        else:
+            block = raw.T.astype(float)
         if band_ascending and self.band_descending:
             block = block[::-1]
         return block
@@ -185,9 +204,16 @@ class FilterbankWriter:
         self.header = dict(header)
         self.nchans = int(self.header["nchans"])
         self.nbits = int(self.header.get("nbits", 32))
-        if self.nbits not in _DTYPES:
+        if self.nbits in (1, 2, 4):
+            if (self.nchans * self.nbits) % 8:
+                raise ValueError(
+                    f"nchans={self.nchans} at nbits={self.nbits} does not "
+                    "pack to whole bytes")
+            self._dtype = np.uint8
+        elif self.nbits in _DTYPES:
+            self._dtype = _DTYPES[self.nbits]
+        else:
             raise ValueError(f"unsupported nbits={self.nbits}")
-        self._dtype = _DTYPES[self.nbits]
         self._file = open(path, "wb")
         self._nsamples_written = 0
         self._file.write(_pack_string("HEADER_START"))
@@ -205,6 +231,13 @@ class FilterbankWriter:
             raise ValueError(f"block has {block.shape[0]} channels, "
                              f"expected {self.nchans}")
         frames = np.ascontiguousarray(block.T)
+        if self.nbits in (1, 2, 4):
+            from .lowbit import pack
+
+            frames = pack(frames, self.nbits)  # clips to [0, 2^nbits - 1]
+            self._file.write(frames.tobytes())
+            self._nsamples_written += block.shape[1]
+            return
         if self.nbits < 32:
             info = np.iinfo(self._dtype)
             frames = np.clip(np.rint(frames), info.min, info.max)

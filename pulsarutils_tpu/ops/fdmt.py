@@ -428,7 +428,7 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
             return plane
         from .search import score_profiles_stacked
 
-        # ONE (4, ndm) output array -> one host readback round trip over
+        # ONE (5, ndm) output array -> one host readback round trip over
         # the tunnel (four separate vectors cost ~0.1 s latency each)
         stacked = score_profiles_stacked(plane, xp=jnp)
         return (stacked, plane) if with_plane else stacked

@@ -24,6 +24,8 @@ under the null, giving closed-form false-alarm probabilities
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from .robust import h_test_batch, ref_mad
@@ -201,6 +203,45 @@ def spectral_search(series, tsamp, max_harmonics=16, fmin=None, fmax=None,
     }
 
 
+_SPEC_KEYS = ("freq", "power", "nharm", "log_sf", "sigma")
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_spectral_stacked(tsamp, max_harmonics, fmin, fmax):
+    """One jitted program per (tsamp, depth, band) running the whole
+    spectral search and returning the five per-row results as ONE
+    ``(5, rows)`` array — eager dispatch costs ~50 op round trips per
+    chunk on the tunnelled platform, plus five readbacks."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(chunk):
+        spec = spectral_search(chunk, tsamp, max_harmonics=max_harmonics,
+                               fmin=fmin, fmax=fmax, xp=jnp)
+        return jnp.stack([spec[k].astype(jnp.float32) if k == "nharm"
+                          else spec[k] for k in _SPEC_KEYS])
+
+    return run
+
+
+def _spectral_chunk(plane_chunk, tsamp, max_harmonics, fmin, fmax, xp):
+    """Spectral-search one row chunk; host dict out (one readback on jax)."""
+    if xp is np:
+        c = spectral_search(np.asarray(plane_chunk), tsamp,
+                            max_harmonics=max_harmonics, fmin=fmin,
+                            fmax=fmax, xp=np)
+        return {k: np.asarray(v) for k, v in c.items()}
+    run = _jitted_spectral_stacked(
+        float(tsamp), int(max_harmonics),
+        None if fmin is None else float(fmin),
+        None if fmax is None else float(fmax))
+    stacked = np.asarray(run(xp.asarray(plane_chunk)))
+    out = dict(zip(_SPEC_KEYS, stacked))
+    out["nharm"] = np.rint(out["nharm"]).astype(np.int32)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Phase folding
 # ---------------------------------------------------------------------------
@@ -291,6 +332,34 @@ def fold_batch(series, freqs, tsamp, nbin=32, t0=0.0, xp=np):
              xp.asarray(step_frac, dtype=series.dtype))
 
 
+def _epoch_fold_score(series, profiles, hits, nmax, xp):
+    """Exposure-correct folded profiles and H-test them (pure, jittable)."""
+    mean_rate = profiles.sum(axis=-1, keepdims=True) / xp.maximum(
+        hits.sum(axis=-1, keepdims=True), 1.0)
+    corrected = profiles - hits * mean_rate
+    sigma = ref_mad(series, xp=xp)
+    total = series.shape[0] * xp.maximum(sigma * sigma, 1e-30)
+    return h_test_batch(corrected, nmax=nmax, xp=xp, total=total)
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_epoch_fold(nbin, nmax):
+    """Fold + exposure-correct + H-test as ONE compiled program (eager
+    dispatch costs ~30 op round trips on the tunnelled platform)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(series, anchors, step_frac):
+        profiles, hits = jax.vmap(
+            lambda a, s: _fold_jax_anchored(series, a, s, nbin))(
+                anchors, step_frac)
+        h, m = _epoch_fold_score(series, profiles, hits, nmax, jnp)
+        return h, m, profiles
+
+    return run
+
+
 def epoch_folding_search(series, tsamp, freqs, nbin=32, nmax=8, xp=np):
     """Refine candidate frequencies by folding + H-test.
 
@@ -304,13 +373,15 @@ def epoch_folding_search(series, tsamp, freqs, nbin=32, nmax=8, xp=np):
     over frequency instead of plane rows.
     """
     series = xp.asarray(series)
+    if xp is not np:
+        freqs64 = np.asarray(freqs, dtype=np.float64)
+        anchors, step_frac = _phase_anchors(series.shape[0], freqs64, tsamp,
+                                            0.0)
+        run = _jitted_epoch_fold(int(nbin), int(nmax))
+        return run(series, xp.asarray(anchors, dtype=series.dtype),
+                   xp.asarray(step_frac, dtype=series.dtype))
     profiles, hits = fold_batch(series, freqs, tsamp, nbin=nbin, xp=xp)
-    mean_rate = profiles.sum(axis=-1, keepdims=True) / xp.maximum(
-        hits.sum(axis=-1, keepdims=True), 1.0)
-    corrected = profiles - hits * mean_rate
-    sigma = ref_mad(series, xp=xp)
-    total = series.shape[0] * xp.maximum(sigma * sigma, 1e-30)
-    h, m = h_test_batch(corrected, nmax=nmax, xp=xp, total=total)
+    h, m = _epoch_fold_score(series, profiles, hits, nmax, xp)
     return h, m, profiles
 
 
@@ -355,19 +426,17 @@ def period_search_plane(plane, tsamp, max_harmonics=16, fmin=None, fmax=None,
     if row_chunk is None:
         row_chunk = max(16, (1 << 27) // max(1, t))
     if ndm <= row_chunk:
-        spec = spectral_search(xp.asarray(plane), tsamp,
-                               max_harmonics=max_harmonics,
-                               fmin=fmin, fmax=fmax, xp=xp)
+        spec = _spectral_chunk(plane, tsamp, max_harmonics, fmin, fmax, xp)
     else:
         chunks = []
         for lo in range(0, ndm, row_chunk):
-            c = spectral_search(xp.asarray(plane[lo:lo + row_chunk]), tsamp,
-                                max_harmonics=max_harmonics, fmin=fmin,
-                                fmax=fmax, xp=xp)
-            # pull to host INSIDE the loop: async dispatch would otherwise
-            # run several chunks' FFT workspaces concurrently in HBM —
-            # the very blow-up the chunking exists to prevent
-            chunks.append({k: np.asarray(v) for k, v in c.items()})
+            # each chunk runs as one jitted program with one host readback
+            # (_spectral_chunk); pulling to host INSIDE the loop keeps a
+            # single chunk's FFT workspace live in HBM at a time — async
+            # dispatch would otherwise run several concurrently, the very
+            # blow-up the chunking exists to prevent
+            chunks.append(_spectral_chunk(plane[lo:lo + row_chunk], tsamp,
+                                          max_harmonics, fmin, fmax, xp))
         spec = {k: np.concatenate([c[k] for c in chunks])
                 for k in chunks[0]}
 

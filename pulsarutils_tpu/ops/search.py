@@ -45,9 +45,12 @@ SEARCH_WINDOWS = (1, 2, 4, 8)
 def score_profiles(plane, xp=np):
     """Score a block of dedispersed series ``(ndm, T)``.
 
-    Returns ``(maxvalues, stds, best_snrs, best_windows)`` per trial,
-    reproducing the reference's per-trial loop
-    (``pulsarutils/dedispersion.py:186-201``) in batched form.
+    Returns ``(maxvalues, stds, best_snrs, best_windows, best_peaks)`` per
+    trial, reproducing the reference's per-trial loop
+    (``pulsarutils/dedispersion.py:186-201``) in batched form, plus the
+    peak's sample index in the unbinned series (``argmax`` of the best
+    window's block sums, scaled back by the window — the reference threw
+    the arrival time away; candidate sifting needs it).
     """
     plane = xp.asarray(plane)
     x = plane - plane.mean(axis=1, keepdims=True)
@@ -56,33 +59,45 @@ def score_profiles(plane, xp=np):
 
     best_snrs = xp.zeros(x.shape[0], dtype=x.dtype)
     best_windows = xp.zeros(x.shape[0], dtype=xp.int32)
+    best_peaks = xp.zeros(x.shape[0], dtype=xp.int32)
     for window in SEARCH_WINDOWS:
         reb = block_sum_time(x, window, xp=xp)
         snr = reb.max(axis=1) / reb.std(axis=1)
+        peak = xp.argmax(reb, axis=1).astype(xp.int32) * window
         better = snr > best_snrs
         best_snrs = xp.where(better, snr, best_snrs)
         best_windows = xp.where(better, window, best_windows)
-    return maxvalues, stds, best_snrs, best_windows
+        best_peaks = xp.where(better, peak, best_peaks)
+    return maxvalues, stds, best_snrs, best_windows, best_peaks
 
 
 def score_profiles_stacked(plane, xp=np):
-    """:func:`score_profiles` packed into ONE ``(4, ndm)`` float array.
+    """:func:`score_profiles` packed into ONE ``(5, ndm)`` float array.
 
     The tunnelled-TPU transfer layer pays a full round trip per array
-    fetched; stacking the four per-trial score vectors device-side makes
-    the whole search's host readback a single transfer.  Row order:
-    ``max, std, snr, window`` (windows are 1..8 — exact in float32).
+    fetched; stacking the per-trial score vectors device-side makes the
+    whole search's host readback a single transfer.  Row order:
+    ``max, std, snr, window, peak`` (windows are 1..8 and peaks are
+    sample indices < 2^24 — both exact in float32).
     """
-    maxvalues, stds, best_snrs, best_windows = score_profiles(plane, xp=xp)
-    return xp.stack([maxvalues, stds, best_snrs,
-                     best_windows.astype(maxvalues.dtype)])
+    if plane.shape[1] > (1 << 24):
+        import warnings
+
+        warnings.warn(
+            f"series length {plane.shape[1]} exceeds 2^24: float32 peak "
+            "indices lose exactness (off by up to "
+            f"{plane.shape[1] / (1 << 24):.1f} samples)", stacklevel=2)
+    scores = score_profiles(plane, xp=xp)
+    dtype = scores[0].dtype
+    return xp.stack([s.astype(dtype) for s in scores])
 
 
 def unstack_scores(stacked):
     """Host-side inverse of :func:`score_profiles_stacked` (one readback)."""
     stacked = np.asarray(stacked)
-    maxvalues, stds, best_snrs, wins = stacked
-    return maxvalues, stds, best_snrs, np.rint(wins).astype(np.int32)
+    maxvalues, stds, best_snrs, wins, peaks = stacked
+    return (maxvalues, stds, best_snrs, np.rint(wins).astype(np.int32),
+            np.rint(peaks).astype(np.int64))
 
 
 #: soft cap on the gather workspace (elements) a single trial-block may
@@ -144,6 +159,7 @@ def _search_numpy(data, trial_dms, start_freq, bandwidth, sample_time,
     stds = np.empty(ndm)
     best_snrs = np.empty(ndm)
     best_windows = np.empty(ndm, dtype=np.int32)
+    best_peaks = np.empty(ndm, dtype=np.int64)
 
     block = 16  # score in small batches to bound the workspace
     work = np.empty((block, nsamples))
@@ -153,13 +169,14 @@ def _search_numpy(data, trial_dms, start_freq, bandwidth, sample_time,
         dedisperse_batch_numpy(data, offsets[lo:hi], out=sub)
         if capture_plane:
             plane[lo:hi] = sub
-        m, s, b, w = score_profiles(sub)
+        m, s, b, w, p = score_profiles(sub)
         maxvalues[lo:hi] = m
         stds[lo:hi] = s
         best_snrs[lo:hi] = b
         best_windows[lo:hi] = w
+        best_peaks[lo:hi] = p
 
-    return maxvalues, stds, best_snrs, best_windows, plane
+    return maxvalues, stds, best_snrs, best_windows, best_peaks, plane
 
 
 # ---------------------------------------------------------------------------
@@ -172,7 +189,7 @@ def search_kernel_fn(data, offset_blocks, capture_plane=False,
 
     ``data`` is ``(nchan, T)``; ``offset_blocks`` is
     ``(nblocks, dm_block, nchan)`` int32 gather offsets.  Returns the
-    per-block stacked scores ``(nblocks, 4, dm_block)`` (see
+    per-block stacked scores ``(nblocks, 5, dm_block)`` (see
     :func:`score_profiles_stacked`) — plus the dedispersed plane blocks
     when ``capture_plane``.  Traceable under ``jit``/``shard_map``; the
     blocks are processed by ``lax.map`` so the compiled program is
@@ -243,15 +260,15 @@ def _search_jax_pallas(data, offsets, capture_plane, dm_block=None,
             # full plane) in HBM, breaking the PALLAS_SUPERBLOCK bound.
             planes.append(plane if ndm <= PALLAS_SUPERBLOCK
                           else np.asarray(plane))
-    maxvalues, stds, best_snrs, best_windows = (
-        np.concatenate([o[i] for o in outs]) for i in range(4))
+    maxvalues, stds, best_snrs, best_windows, best_peaks = (
+        np.concatenate([o[i] for o in outs]) for i in range(5))
     if not capture_plane:
         plane = None
     elif len(planes) == 1:
         plane = planes[0]
     else:
         plane = np.concatenate(planes)
-    return maxvalues, stds, best_snrs, best_windows, plane
+    return maxvalues, stds, best_snrs, best_windows, best_peaks, plane
 
 
 def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
@@ -284,8 +301,10 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         stacked, plane_out = out  # plane stays device-resident
     else:
         stacked, plane_out = out, None
-    maxvalues, stds, best_snrs, best_windows = unstack_scores(stacked)
-    return trial_dms, maxvalues, stds, best_snrs, best_windows, plane_out
+    (maxvalues, stds, best_snrs, best_windows,
+     best_peaks) = unstack_scores(stacked)
+    return (trial_dms, maxvalues, stds, best_snrs, best_windows, best_peaks,
+            plane_out)
 
 
 def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
@@ -325,16 +344,17 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
     gather_kernel = _jax_search_kernel(capture_plane, chan_block)
     out = gather_kernel(data, jnp.asarray(offset_blocks))
-    stacked = out[0] if capture_plane else out  # (nblocks, 4, dm_block)
-    stacked = np.asarray(stacked).transpose(1, 0, 2).reshape(4, -1)[:, :ndm]
-    maxvalues, stds, best_snrs, best_windows = unstack_scores(stacked)
+    stacked = out[0] if capture_plane else out  # (nblocks, 5, dm_block)
+    stacked = np.asarray(stacked).transpose(1, 0, 2).reshape(5, -1)[:, :ndm]
+    (maxvalues, stds, best_snrs, best_windows,
+     best_peaks) = unstack_scores(stacked)
     if capture_plane:  # keep device-resident (see _search_jax_pallas)
         plane = out[1].reshape(-1, *out[1].shape[2:])
         if plane.shape[0] != ndm:  # slicing outside jit is a real copy
             plane = plane[:ndm]
     else:
         plane = None
-    return maxvalues, stds, best_snrs, best_windows, plane
+    return maxvalues, stds, best_snrs, best_windows, best_peaks, plane
 
 
 # ---------------------------------------------------------------------------
@@ -373,8 +393,9 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     Returns
     -------
     :class:`~pulsarutils_tpu.utils.table.ResultTable` with columns
-    ``DM, max, std, snr, rebin`` — plus the ``(ndm, nsamples)`` plane if
-    ``show``/``capture_plane``.
+    ``DM, max, std, snr, rebin, peak`` (``peak`` = sample index of the
+    best-window maximum — arrival time within the chunk) — plus the
+    ``(ndm, nsamples)`` plane if ``show``/``capture_plane``.
     """
     nchan = data.shape[0]
     if capture_plane is None:
@@ -395,7 +416,7 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         if trial_dms is not None:
             dmmin = float(np.min(trial_dms))
             dmmax = float(np.max(trial_dms))
-        (trial_dms, maxvalues, stds, best_snrs, best_windows,
+        (trial_dms, maxvalues, stds, best_snrs, best_windows, best_peaks,
          plane) = _search_jax_fdmt(data, dmmin, dmmax, start_freq,
                                    bandwidth, sample_time, capture_plane)
         table = ResultTable({
@@ -404,6 +425,7 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
             "std": stds,
             "snr": best_snrs,
             "rebin": best_windows,
+            "peak": best_peaks,
         })
         return (table, plane) if (capture_plane or show) else table
 
@@ -413,12 +435,14 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     trial_dms = np.asarray(trial_dms, dtype=np.float64)
 
     if backend == "numpy":
-        maxvalues, stds, best_snrs, best_windows, plane = _search_numpy(
-            data, trial_dms, start_freq, bandwidth, sample_time, capture_plane)
+        (maxvalues, stds, best_snrs, best_windows, best_peaks,
+         plane) = _search_numpy(data, trial_dms, start_freq, bandwidth,
+                                sample_time, capture_plane)
     elif backend == "jax":
-        maxvalues, stds, best_snrs, best_windows, plane = _search_jax(
-            data, trial_dms, start_freq, bandwidth, sample_time, capture_plane,
-            dm_block, chan_block, dtype, kernel)
+        (maxvalues, stds, best_snrs, best_windows, best_peaks,
+         plane) = _search_jax(data, trial_dms, start_freq, bandwidth,
+                              sample_time, capture_plane, dm_block,
+                              chan_block, dtype, kernel)
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -428,6 +452,7 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         "std": stds,
         "snr": best_snrs,
         "rebin": best_windows,
+        "peak": best_peaks,
     })
     if capture_plane or show:
         return table, plane

@@ -28,7 +28,12 @@ import numpy as np
 
 from ..ops.dedisperse import dedisperse_block_chunked_jax
 from ..ops.plan import dedispersion_plan
-from ..ops.search import _offsets_for, auto_chan_block, score_profiles
+from ..ops.search import (
+    _offsets_for,
+    auto_chan_block,
+    score_profiles_stacked,
+    unstack_scores,
+)
 from ..utils.table import ResultTable
 from .mesh import pad_to_multiple
 
@@ -56,19 +61,22 @@ def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
             # rotation is a traced operand so plans whose rebase constant
             # differs still share this compiled program
             dedisp = jnp.roll(dedisp, -roll_k, axis=1)
-        scores = score_profiles(dedisp, xp=jnp)
+        # ONE stacked (5, D_loc) score array -> one host readback (each
+        # fetched array costs a full round trip on tunnelled platforms)
+        stacked = score_profiles_stacked(dedisp, xp=jnp)
         if capture_plane:
-            return scores + (dedisp,)
-        return scores
+            return stacked, dedisp
+        return stacked
 
-    out_scores = (P("dm"), P("dm"), P("dm"), P("dm"))
-    out_specs = out_scores + ((P("dm", None),) if capture_plane else ())
+    out_scores = P(None, "dm")
+    out_specs = ((out_scores, P("dm", None)) if capture_plane
+                 else out_scores)
 
     fn = jax.shard_map(
         local_search,
         mesh=mesh,
         in_specs=(P("chan", None), P("dm", "chan"), P()),
-        out_specs=out_specs if capture_plane else out_scores,
+        out_specs=out_specs,
         # pallas_call outputs carry no varying-mesh-axes metadata, which
         # trips shard_map's vma lint; the collective structure here is a
         # single explicit psum, so the check adds nothing
@@ -142,12 +150,13 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     out = compiled(jnp.asarray(data_padded, dtype=dtype),
                    jnp.asarray(offsets), jnp.int32(roll_k))
 
-    out = [np.asarray(o)[:ndm] for o in out]
     if capture_plane:
-        maxvalues, stds, best_snrs, best_windows, plane = out
+        stacked, plane = out
+        plane = np.asarray(plane)[:ndm]
     else:
-        maxvalues, stds, best_snrs, best_windows = out
-        plane = None
+        stacked, plane = out, None
+    maxvalues, stds, best_snrs, best_windows, best_peaks = unstack_scores(
+        np.asarray(stacked)[:, :ndm])
 
     table = ResultTable({
         "DM": trial_dms,
@@ -155,6 +164,7 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
         "std": stds,
         "snr": best_snrs,
         "rebin": best_windows,
+        "peak": best_peaks,
     })
     if capture_plane:
         return table, plane

@@ -1,0 +1,99 @@
+"""Candidate sifting: collapse duplicate detections of one physical pulse.
+
+The streaming driver advances by 50% of the chunk length (reference
+``clean.py:318``), so every pulse is fully contained in at least one chunk
+— and therefore *detected in up to two* (plus trial-DM neighbours within a
+chunk).  The reference persists every per-chunk hit separately
+(``clean.py:349-351``), leaving deduplication to the human.  This module
+groups hits whose (absolute arrival time, DM) fall within a matching
+radius and keeps the highest-S/N member of each group — the standard
+"sifting" stage of modern single-pulse pipelines.
+
+Pure host-side post-processing: candidate lists are tiny compared to the
+data, so no device work is warranted.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sift_hits", "sift_candidates"]
+
+
+def _hit_fields(istart, iend, info, table):
+    """Arrival time (s), DM, S/N and width (s) of one chunk hit."""
+    best = table.best_row()
+    tsamp = 1.0 / (info.pulse_freq * info.nbin)
+    # absolute arrival time: chunk start + the scorer's in-chunk peak
+    # sample (the ``peak`` table column; tables without it fall back to
+    # the chunk start — the default time radius is chunk-scale).  A
+    # record with no populated t0 (pre-t0 save) gets the best-effort
+    # ``istart * tsamp`` (offset when the pipeline resampled: istart is
+    # in file samples, tsamp is the effective one).
+    t0 = getattr(info, "t0", None)
+    t_peak = float(t0) if t0 is not None else istart * tsamp
+    if "peak" in table.colnames:
+        t_peak = t_peak + float(best["peak"]) * tsamp
+    return {
+        "istart": int(istart),
+        "iend": int(iend),
+        "time": float(t_peak),
+        "dm": float(best["DM"]),
+        "snr": float(best["snr"]),
+        "width": float(best["rebin"]) * tsamp,
+        "info": info,
+        "table": table,
+    }
+
+
+def sift_candidates(cands, time_radius, dm_radius):
+    """Group candidate dicts (keys ``time, dm, snr``) and keep each group's
+    best.
+
+    Greedy single-linkage in descending S/N order: a candidate joins the
+    first kept group within ``time_radius`` seconds AND ``dm_radius`` DM
+    units; otherwise it seeds a new group.  Returns the kept candidates
+    (descending S/N), each annotated with ``n_members`` — the number of
+    raw detections it absorbed.
+    """
+    order = sorted(range(len(cands)), key=lambda i: -cands[i]["snr"])
+    kept = []
+    for i in order:
+        c = cands[i]
+        for k in kept:
+            if (abs(c["time"] - k["time"]) <= time_radius
+                    and abs(c["dm"] - k["dm"]) <= dm_radius):
+                k["n_members"] += 1
+                break
+        else:
+            kept.append({**c, "n_members": 1})
+    return kept
+
+
+def sift_hits(hits, time_radius=None, dm_radius=None):
+    """Sift the ``hits`` list returned by
+    :func:`~pulsarutils_tpu.pipeline.search_pipeline.search_by_chunks`
+    (``(istart, iend, PulseInfo, ResultTable)`` tuples).
+
+    Default radii: ``time_radius`` = 1.5 chunk spans — duplicate
+    detections from the 50% overlap land within one hop, and chunks
+    holding only part of a pulse detect its *circular-wrap artifact* up
+    to a chunk span (+ its width) away (the roll convention wraps the
+    dispersed tail, reference ``dedispersion.py:60-98``); ``dm_radius`` =
+    2% of the best DM + 1 (trial-grid neighbours and chunk-to-chunk
+    jitter).
+
+    Returns a list of candidate dicts (descending S/N) with keys
+    ``time, dm, snr, width, istart, iend, n_members, info, table``.
+    """
+    if not hits:
+        return []
+    cands = [_hit_fields(*h) for h in hits]
+    if time_radius is None:
+        spans = [(c["iend"] - c["istart"]) for c in cands]
+        tsamp = [c["width"] / max(1e-30, float(c["table"].best_row()["rebin"]))
+                 for c in cands]
+        time_radius = 1.5 * max(s * t for s, t in zip(spans, tsamp))
+    if dm_radius is None:
+        dm_radius = 0.02 * max(c["dm"] for c in cands) + 1.0
+    return sift_candidates(cands, time_radius, dm_radius)

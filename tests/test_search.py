@@ -112,13 +112,15 @@ def test_score_profiles_reference_semantics():
     profiles = rng.normal(size=(5, 100))  # odd length exercises truncation
     profiles[1, 40:44] += 5.0  # aligned wide pulse
     profiles[2, 7] += 8.0      # narrow pulse
-    maxv, stds, snr, win = score_profiles(profiles)
+    maxv, stds, snr, win, peak = score_profiles(profiles)
     for i in range(5):
         m, s, b, w = _reference_score(profiles[i])
         assert maxv[i] == pytest.approx(m)
         assert stds[i] == pytest.approx(s)
         assert snr[i] == pytest.approx(b)
         assert win[i] == w
+    # peak of the narrow-pulse row is the injected sample (window 1)
+    assert win[2] == 1 and peak[2] == 7
 
 
 def test_score_profiles_stacked_round_trip():
@@ -132,10 +134,11 @@ def test_score_profiles_stacked_round_trip():
     profiles = rng.normal(size=(7, 96)).astype(np.float32)
     profiles[3, 10] += 9.0
     stacked = score_profiles_stacked(profiles)
-    assert stacked.shape == (4, 7)
-    maxv, stds, snr, win = unstack_scores(stacked)
-    m0, s0, b0, w0 = score_profiles(profiles)
+    assert stacked.shape == (5, 7)
+    maxv, stds, snr, win, peak = unstack_scores(stacked)
+    m0, s0, b0, w0, p0 = score_profiles(profiles)
     assert np.allclose(maxv, m0)
     assert np.allclose(stds, s0)
     assert np.allclose(snr, b0)
     assert win.dtype == np.int32 and np.array_equal(win, w0)
+    assert peak.dtype == np.int64 and np.array_equal(peak, p0)

@@ -1,0 +1,53 @@
+"""Candidate sifting: duplicate detections collapse to one candidate."""
+import numpy as np
+
+from pulsarutils_tpu.pipeline.sift import sift_candidates, sift_hits
+
+
+def test_sift_candidates_groups_by_radius():
+    cands = [
+        {"time": 1.00, "dm": 150.0, "snr": 8.0},
+        {"time": 1.01, "dm": 151.0, "snr": 12.0},  # same event, higher S/N
+        {"time": 5.00, "dm": 150.5, "snr": 7.0},   # same DM, far in time
+        {"time": 1.00, "dm": 400.0, "snr": 9.0},   # same time, far in DM
+    ]
+    kept = sift_candidates(cands, time_radius=0.1, dm_radius=5.0)
+    assert len(kept) == 3
+    assert kept[0]["snr"] == 12.0 and kept[0]["n_members"] == 2
+    assert sorted(k["snr"] for k in kept) == [7.0, 9.0, 12.0]
+
+
+def test_sift_candidates_descending_snr_and_empty():
+    assert sift_candidates([], 1.0, 1.0) == []
+    cands = [{"time": t, "dm": 100.0, "snr": s}
+             for t, s in [(0.0, 5.0), (10.0, 9.0), (20.0, 7.0)]]
+    kept = sift_candidates(cands, time_radius=1.0, dm_radius=1.0)
+    assert [k["snr"] for k in kept] == [9.0, 7.0, 5.0]
+
+
+def test_sift_hits_collapses_overlap_duplicates(tmp_path):
+    # a single strong pulse is detected in both 50%-overlapping chunks
+    # that contain it; sifting must merge them into one candidate at the
+    # right arrival time and DM
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+    array, header = simulate_test_data(150, nchan=64, nsamples=16384,
+                                       signal=2.0, noise=0.4, rng=5)
+    path = str(tmp_path / "pulse.fil")
+    write_simulated_filterbank(path, array, header)
+    hits, _ = search_by_chunks(path, dmmin=100, dmmax=200, backend="numpy",
+                               make_plots=False, resume=False,
+                               progress=False,
+                               output_dir=str(tmp_path / "out"))
+    assert len(hits) >= 2  # duplicate detections from the overlap
+
+    sifted = sift_hits(hits)
+    assert len(sifted) == 1
+    best = sifted[0]
+    assert best["n_members"] == len(hits)
+    assert abs(best["dm"] - 150) <= 2.0
+    # pulse injected at nsamples // 2
+    t_true = (16384 // 2) * header["tsamp"]
+    assert abs(best["time"] - t_true) <= 0.05
