@@ -31,10 +31,13 @@ def build_parser():
     parser.add_argument("--surelybad", type=int, nargs="*", default=[])
     parser.add_argument("--backend", choices=("jax", "numpy"), default="jax")
     parser.add_argument("--kernel",
-                        choices=("auto", "pallas", "gather", "fdmt"),
+                        choices=("auto", "pallas", "gather", "fdmt",
+                                 "fourier"),
                         default="auto",
                         help="jax-path kernel; fdmt = tree dedispersion "
-                             "(fastest dense sweep, tree-rounded tracks)")
+                             "(fastest dense sweep, tree-rounded tracks); "
+                             "fourier = exact fractional-sample delays "
+                             "(precision option)")
     parser.add_argument("--fft-zap", action="store_true",
                         help="excise periodic RFI in the Fourier domain")
     parser.add_argument("--cut-outliers", action="store_true",

@@ -332,6 +332,17 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
         data = jnp.asarray(data, dtype=jnp.float32)
         return _search_jax_pallas(data, offsets, capture_plane, dm_block,
                                   chan_block)
+    if kernel == "fourier":
+        from .fourier import search_fourier
+
+        if dtype not in (None, jnp.float32):
+            raise ValueError("kernel='fourier' supports float32 only")
+        # pass data through untouched: only its shape is needed host-side
+        # (np.asarray here would read a device-resident chunk back over
+        # the slow link just to re-upload it)
+        return search_fourier(data, trial_dms, start_freq, bandwidth,
+                              sample_time, capture_plane=capture_plane,
+                              dm_block=dm_block, chan_block=chan_block)
 
     dtype = dtype or jnp.float32
     data = jnp.asarray(data, dtype=dtype)
@@ -384,11 +395,14 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     kernel : JAX-path kernel selector: ``"auto"`` (Pallas on TPU, gather
         elsewhere), ``"pallas"`` (hand-written tiled TPU kernel, see
         :mod:`.pallas_dedisperse`), ``"gather"`` (portable XLA
-        ``take_along_axis`` formulation) or ``"fdmt"`` (tree dedispersion,
+        ``take_along_axis`` formulation), ``"fdmt"`` (tree dedispersion,
         O(nchan log nchan) instead of O(ndm * nchan) — fastest for dense
         DM sweeps; uses its own integer band-delay trial grid and tree-
         rounded tracks, so hits agree with the exact kernels to within a
-        trial but not bit-identically; see :mod:`.fdmt`).
+        trial but not bit-identically; see :mod:`.fdmt`) or ``"fourier"``
+        (Fourier-domain dedispersion: exact *fractional*-sample delays —
+        the precision option for narrow pulses at high time resolution;
+        O(ndm * nchan * T) with transcendentals, see :mod:`.fourier`).
 
     Returns
     -------

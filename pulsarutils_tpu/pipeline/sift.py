@@ -37,6 +37,11 @@ def _hit_fields(istart, iend, info, table):
     return {
         "istart": int(istart),
         "iend": int(iend),
+        # chunk duration in seconds: nbin is the post-resample sample
+        # count of the searched array, tsamp its effective sample time
+        # (istart/iend are in FILE samples — a different unit whenever
+        # the pipeline resampled)
+        "span": float(info.nbin) * tsamp,
         "time": float(t_peak),
         "dm": float(best["DM"]),
         "snr": float(best["snr"]),
@@ -90,10 +95,7 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
         return []
     cands = [_hit_fields(*h) for h in hits]
     if time_radius is None:
-        spans = [(c["iend"] - c["istart"]) for c in cands]
-        tsamp = [c["width"] / max(1e-30, float(c["table"].best_row()["rebin"]))
-                 for c in cands]
-        time_radius = 1.5 * max(s * t for s, t in zip(spans, tsamp))
+        time_radius = 1.5 * max(c["span"] for c in cands)
     if dm_radius is None:
         dm_radius = 0.02 * max(c["dm"] for c in cands) + 1.0
     return sift_candidates(cands, time_radius, dm_radius)

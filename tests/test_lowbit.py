@@ -37,7 +37,7 @@ def test_pack_clips_out_of_range():
     assert np.array_equal(out, [0, 0, 1, 2, 3, 3, 2, 1])
 
 
-@pytest.mark.parametrize("nbits", [2, 4])
+@pytest.mark.parametrize("nbits", [1, 2, 4])
 def test_filterbank_lowbit_round_trip(tmp_path, rng, nbits):
     nchan, nsamp = 16, 64
     maxval = (1 << nbits) - 1

@@ -71,6 +71,33 @@ def _search_parity():
     assert t_pl.argbest() == t_np.argbest(), (t_pl.argbest(), t_np.argbest())
 
 
+@check("fourier kernel: DM recovered, agrees with numpy FDD")
+def _fourier():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.ops.fourier import dedisperse_fourier
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    array, header = simulate_test_data(150, nchan=64, nsamples=8192,
+                                       signal=2.0, noise=0.3, rng=13)
+    args = (100, 200.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+    table = dedispersion_search(array, *args, backend="jax",
+                                kernel="fourier")
+    best = float(table["DM"][table.argbest()])
+    assert abs(best - 150) <= 1.5, best
+    dms = np.linspace(140, 160, 5)
+    ref = dedisperse_fourier(array, dms, header["fbottom"],
+                             header["bandwidth"], header["tsamp"], xp=np)
+    got = np.asarray(dedisperse_fourier(array, dms, header["fbottom"],
+                                        header["bandwidth"],
+                                        header["tsamp"], xp=jnp))
+    err = float(np.abs(got - ref).max() / np.abs(ref).max())
+    assert err < 1e-2, err
+
+
 @check("fdmt: compiled merge == XLA merge; DM recovered")
 def _fdmt():
     import numpy as np
