@@ -82,38 +82,49 @@ def _jitted_fourier(t, dm_block, chan_block, with_scores, with_plane=True):
     import jax
     import jax.numpy as jnp
 
-    def one_block(spec_b, delays_b, f):
-        # spec_b (C_b, F) complex; delays_b (D_b, C_b) in samples
-        phase = jnp.exp((2j * jnp.pi) * f[None, None, :]
-                        * delays_b[:, :, None].astype(jnp.float32))
+    def one_block(spec_b, limbs_b, k, kf):
+        # spec_b (C_b, F) complex; limbs_b (3, D_b, C_b) int32 12-bit
+        # limbs of the per-(trial, channel) phase slope (see
+        # _phase_limbs).  The phase at rfft bin k is k * M / 2^36 cycles
+        # with M = M1*2^24 + M2*2^12 + M3; each k*Mi fits the wrapping
+        # int32 product's congruence class, so the fractional cycles are
+        # exact to 2^-24 — float32 `f * tau` would be off by ~0.1 rad at
+        # the 1M-sample sizes this kernel exists to serve.
+        m1, m2, m3 = (limbs_b[i][:, :, None] for i in range(3))
+        th = (((k * m1) & 0xFFF).astype(jnp.float32) / (1 << 12)
+              + ((k * m2) & 0xFFFFFF).astype(jnp.float32) / (1 << 24)
+              + kf * m3.astype(jnp.float32) / np.float32(1 << 36))
+        phase = jnp.exp((2j * jnp.pi) * th)
         return (spec_b[None, :, :] * phase).sum(axis=1)  # (D_b, F)
 
     keep_plane = with_plane or not with_scores
 
     @jax.jit
-    def run(data, delays):
+    def run(data, limbs):
         from .search import score_profiles_stacked
 
         spec = jnp.fft.rfft(data, axis=1)
-        f = jnp.fft.rfftfreq(t, d=1.0).astype(jnp.float32)  # delays pre-scaled
+        nbin = t // 2 + 1
+        k = jnp.arange(nbin, dtype=jnp.int32)[None, None, :]
+        kf = k.astype(jnp.float32)
         nchan = data.shape[0]
-        ndm = delays.shape[0]
+        ndm = limbs.shape[1]
         nc = -(-nchan // chan_block)
         nd = -(-ndm // dm_block)
         spec = jnp.pad(spec, ((0, nc * chan_block - nchan), (0, 0)))
-        delays_p = jnp.pad(delays, ((0, nd * dm_block - ndm),
-                                    (0, nc * chan_block - nchan)))
+        limbs_p = jnp.pad(limbs, ((0, 0), (0, nd * dm_block - ndm),
+                                  (0, nc * chan_block - nchan)))
 
         def series_block(i):
-            dl = jax.lax.dynamic_slice_in_dim(delays_p, i * dm_block,
-                                              dm_block, axis=0)
+            dl = jax.lax.dynamic_slice_in_dim(limbs_p, i * dm_block,
+                                              dm_block, axis=1)
 
             def chan_step(j, acc_spec):
                 sp = jax.lax.dynamic_slice_in_dim(spec, j * chan_block,
                                                   chan_block, axis=0)
                 db = jax.lax.dynamic_slice_in_dim(dl, j * chan_block,
-                                                  chan_block, axis=1)
-                return acc_spec + one_block(sp, db, f)
+                                                  chan_block, axis=2)
+                return acc_spec + one_block(sp, db, k, kf)
 
             out_spec = jax.lax.fori_loop(
                 0, nc, chan_step,
@@ -146,6 +157,24 @@ def _jitted_fourier(t, dm_block, chan_block, with_scores, with_plane=True):
     return run
 
 
+def _phase_limbs(delays, sample_time, t):
+    """Host-side exact phase-slope limbs for the device kernel.
+
+    The phase at rfft bin ``k`` is ``k * A mod 1`` cycles with
+    ``A = tau / (tsamp * T)``.  ``A mod 1`` is quantised to 36 bits
+    (float64 is exact here) and split into three 12-bit limbs so the
+    device can form ``k * A mod 1`` with wrapping int32 products —
+    phase error <= 2pi * T/2 * 2^-37 ~ 4e-7 rad even at T = 2^20.
+
+    Returns int32 ``(3, ndm, nchan)``.
+    """
+    a = np.asarray(delays, dtype=np.float64) / (sample_time * t)
+    m = np.rint((a % 1.0) * (1 << 36)).astype(np.int64) & ((1 << 36) - 1)
+    return np.stack([(m >> 24).astype(np.int32),
+                     ((m >> 12) & 0xFFF).astype(np.int32),
+                     (m & 0xFFF).astype(np.int32)])
+
+
 def dedisperse_fourier(data, trial_dms, start_freq, bandwidth, sample_time,
                        xp=np, dm_block=None, chan_block=None):
     """Dedisperse ``data`` at exact (fractional-sample) delays per trial.
@@ -163,10 +192,8 @@ def dedisperse_fourier(data, trial_dms, start_freq, bandwidth, sample_time,
     run = _jitted_fourier(t, dm_block or FOURIER_DM_BLOCK,
                           chan_block or FOURIER_CHAN_BLOCK,
                           with_scores=False)
-    # pre-scale: the device phase uses cycles-per-sample frequencies, so
-    # delays are shipped in samples (tau / tsamp)
     return run(jnp.asarray(data, jnp.float32),
-               jnp.asarray(delays / sample_time, jnp.float32))
+               jnp.asarray(_phase_limbs(delays, sample_time, t)))
 
 
 def search_fourier(data, trial_dms, start_freq, bandwidth, sample_time,
@@ -184,7 +211,7 @@ def search_fourier(data, trial_dms, start_freq, bandwidth, sample_time,
                           chan_block or FOURIER_CHAN_BLOCK,
                           with_scores=True, with_plane=bool(capture_plane))
     out = run(jnp.asarray(data, jnp.float32),
-              jnp.asarray(delays / sample_time, jnp.float32))
+              jnp.asarray(_phase_limbs(delays, sample_time, t)))
     if capture_plane:
         stacked, plane = out
     else:

@@ -314,6 +314,19 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
     nchan, nsamples = np.shape(data)
     ndm = len(trial_dms)
+
+    if kernel == "fourier":
+        from .fourier import search_fourier
+
+        if dtype not in (None, jnp.float32):
+            raise ValueError("kernel='fourier' supports float32 only")
+        # before the integer-offset table: the FDD uses un-rounded delays
+        # (and data passes through untouched — converting a
+        # device-resident chunk would bounce it over the slow link)
+        return search_fourier(data, trial_dms, start_freq, bandwidth,
+                              sample_time, capture_plane=capture_plane,
+                              dm_block=dm_block, chan_block=chan_block)
+
     offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
                            sample_time, nsamples)
 
@@ -332,18 +345,6 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
         data = jnp.asarray(data, dtype=jnp.float32)
         return _search_jax_pallas(data, offsets, capture_plane, dm_block,
                                   chan_block)
-    if kernel == "fourier":
-        from .fourier import search_fourier
-
-        if dtype not in (None, jnp.float32):
-            raise ValueError("kernel='fourier' supports float32 only")
-        # pass data through untouched: only its shape is needed host-side
-        # (np.asarray here would read a device-resident chunk back over
-        # the slow link just to re-upload it)
-        return search_fourier(data, trial_dms, start_freq, bandwidth,
-                              sample_time, capture_plane=capture_plane,
-                              dm_block=dm_block, chan_block=chan_block)
-
     dtype = dtype or jnp.float32
     data = jnp.asarray(data, dtype=dtype)
 

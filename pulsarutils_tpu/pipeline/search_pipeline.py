@@ -197,8 +197,28 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     from concurrent.futures import ThreadPoolExecutor
 
     def read_at(s):
-        return reader.read_block(s, min(plan.step, nsamples - s),
-                                 band_ascending=True)
+        block = reader.read_block(s, min(plan.step, nsamples - s),
+                                  band_ascending=True)
+        # start the host->device transfer ON the reader thread (device_put
+        # is async and thread-safe): the upload of chunk k+1 then overlaps
+        # the search of chunk k — on slow links the transfer dominates the
+        # whole stream.  COST: peak HBM carries one extra raw chunk
+        # (chunk k+1's buffer coexists with chunk k's pipeline); chunk
+        # sizing already leaves that headroom (a raw chunk is small next
+        # to the captured plane), and a device OOM here degrades to
+        # host cleaning rather than failing the run.
+        # ``device_clean`` is read at call time, so once the main loop
+        # disables device cleaning no more uploads start.  The raw host
+        # block is always returned too: the fallback path must never
+        # depend on a possibly-poisoned device buffer.
+        if device_clean is not None:
+            try:
+                import jax
+
+                return block, jax.device_put(np.ascontiguousarray(block))
+            except Exception:  # upload failure surfaces on the main path
+                return block, None
+        return block, None
 
     reader_pool = ThreadPoolExecutor(max_workers=1)
     next_read = reader_pool.submit(read_at, todo[0]) if todo else None
@@ -209,13 +229,15 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             t0 = istart * sample_time
 
             with with_timer("read"):
-                array = next_read.result()
+                array, array_dev = next_read.result()
             next_read = (reader_pool.submit(read_at, todo[ichunk + 1])
                          if ichunk + 1 < len(todo) else None)
             with with_timer("clean"):
                 if device_clean is not None:
                     try:
-                        cleaned = device_clean(jnp.asarray(array), mask_dev)
+                        src = (array_dev if array_dev is not None
+                               else jnp.asarray(array))
+                        cleaned = device_clean(src, mask_dev)
                         # force: dispatch is async, so a device failure
                         # would otherwise surface as a poisoned array
                         # later, past both fallbacks (block_until_ready

@@ -74,3 +74,27 @@ def test_search_fourier_recovers_dm():
     t2, plane = dedispersion_search(array, *args, backend="jax",
                                     kernel="fourier", show=True)
     assert plane.shape == (t2.nrows, 2048)
+
+
+def test_phase_limbs_exact_at_long_t(rng):
+    # the integer-limb phase path must stay exact where float32 f*tau
+    # loses ~0.1 rad: a 2^20-sample series with a large fractional delay
+    import jax.numpy as jnp
+
+    t = 1 << 20
+    data = np.zeros((1, t), dtype=np.float32)
+    data[0, t // 2] = 1.0
+    delay_samples = 524288.25  # half the series + a quarter sample
+    delays = np.array([[delay_samples * GEOM[2]]])
+
+    from pulsarutils_tpu.ops.fourier import _jitted_fourier, _phase_limbs
+    run = _jitted_fourier(t, 1, 1, with_scores=False)
+    plane = np.asarray(run(jnp.asarray(data),
+                           jnp.asarray(_phase_limbs(delays, GEOM[2], t))))
+    # out[t'] = x[(t' + 524288.25) mod T]: the impulse at t0 = 524288
+    # appears at t' = t0 - delay = -0.25, i.e. split between the two
+    # straddling bins T-1 and 0 by sinc interpolation
+    top2 = np.sort(np.argsort(plane[0])[-2:])
+    assert np.array_equal(top2, [0, t - 1]), top2
+    # energy preserved (unitary phase ramp)
+    assert np.isclose(plane.sum(), 1.0, atol=1e-3)
