@@ -39,3 +39,9 @@ def main(args=None):
         cleanup_data(fname, outname, surelybad=opts.surelybad,
                      fft_zap=opts.fft_zap, chunksize=opts.chunksize)
     return 0
+
+
+if __name__ == "__main__":  # python -m pulsarutils_tpu.cli.clean_main
+    import sys
+
+    sys.exit(main())

@@ -59,8 +59,26 @@ def build_parser():
     return parser
 
 
+def _enable_compile_cache():
+    """Persist XLA compilations across CLI invocations (big-chunk kernel
+    compiles run minutes cold, seconds cached)."""
+    import os
+
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/pulsarutils_tpu_jax"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:  # cache is an optimisation, never a requirement
+        pass
+
+
 def main(args=None):
     opts = build_parser().parse_args(args)
+    if opts.backend == "jax":
+        _enable_compile_cache()
     total_raw = 0
     total_cands = 0
     for fname in opts.fnames:
@@ -101,3 +119,9 @@ def main(args=None):
     logger.info("total candidates: %d (%d raw detections)",
                 total_cands, total_raw)
     return 0
+
+
+if __name__ == "__main__":  # python -m pulsarutils_tpu.cli.search_main
+    import sys
+
+    sys.exit(main())

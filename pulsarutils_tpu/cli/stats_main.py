@@ -56,3 +56,9 @@ def _plot_bandpass(spectra, mask, outname):
     fig.savefig(outname, bbox_inches="tight")
     plt.close(fig)
     logger.info("bandpass plot -> %s", outname)
+
+
+if __name__ == "__main__":  # python -m pulsarutils_tpu.cli.stats_main
+    import sys
+
+    sys.exit(main())

@@ -47,6 +47,27 @@ def _symmetric_pad_1d(x, left, right, xp):
     return xp.concatenate([x[:left][::-1], x, x[n - right:][::-1]])
 
 
+def _convolve_valid(padded, kernel, xp):
+    """``convolve(padded, kernel, mode='valid')`` with a TPU-safe jax path.
+
+    ``xp.convolve`` lowers to ``conv_general_dilated``; at awkward
+    lengths (e.g. 120000-sample chunks) XLA:TPU's convolution tiling
+    compiles pathologically (observed: minutes to never).  The jax path
+    therefore runs the convolution in the Fourier domain at a
+    power-of-two size — deterministic compile, exact same 'valid' slice.
+    """
+    kernel = xp.asarray(kernel, dtype=float)
+    if xp is np:
+        return np.convolve(padded, kernel, mode="valid")
+    n = int(padded.shape[0])
+    k = int(kernel.shape[0])
+    m = n + k - 1
+    size = 1 << int(np.ceil(np.log2(max(m, 2))))
+    full = xp.fft.irfft(xp.fft.rfft(padded, n=size)
+                        * xp.fft.rfft(kernel, n=size), n=size)
+    return full[k - 1:n]
+
+
 def gaussian_filter_1d(x, sigma, truncate=4.0, xp=np):
     """Gaussian smoothing matching ``scipy.ndimage.gaussian_filter1d``
     (mode='reflect', radius ``int(truncate * sigma + 0.5)``)."""
@@ -67,7 +88,7 @@ def gaussian_filter_1d(x, sigma, truncate=4.0, xp=np):
         take_l, take_r = min(left, n), min(right, n)
         padded = _symmetric_pad_1d(padded, take_l, take_r, xp)
         left, right = left - take_l, right - take_r
-    return xp.convolve(padded, xp.asarray(kernel), mode="valid")
+    return _convolve_valid(padded, kernel, xp)
 
 
 def uniform_filter_1d(x, size, xp=np):
@@ -80,8 +101,8 @@ def uniform_filter_1d(x, size, xp=np):
     left = size // 2
     right = size - 1 - left
     padded = _symmetric_pad_1d(x, left, right, xp)
-    kernel = xp.full(size, 1.0 / size)
-    return xp.convolve(padded, kernel, mode="valid")
+    kernel = np.full(size, 1.0 / size)
+    return _convolve_valid(padded, kernel, xp)
 
 
 # ---------------------------------------------------------------------------

@@ -87,9 +87,10 @@ def _jitted_fourier(t, dm_block, chan_block, with_scores, with_plane=True):
         # limbs of the per-(trial, channel) phase slope (see
         # _phase_limbs).  The phase at rfft bin k is k * M / 2^36 cycles
         # with M = M1*2^24 + M2*2^12 + M3; each k*Mi fits the wrapping
-        # int32 product's congruence class, so the fractional cycles are
-        # exact to 2^-24 — float32 `f * tau` would be off by ~0.1 rad at
-        # the 1M-sample sizes this kernel exists to serve.
+        # int32 product's congruence class, so the phase error is bounded
+        # by the 36-bit quantisation of the slope (~2.4e-5 rad at
+        # T = 2^20) — float32 `f * tau` would be off by ~0.1 rad at the
+        # 1M-sample sizes this kernel exists to serve.
         m1, m2, m3 = (limbs_b[i][:, :, None] for i in range(3))
         th = (((k * m1) & 0xFFF).astype(jnp.float32) / (1 << 12)
               + ((k * m2) & 0xFFFFFF).astype(jnp.float32) / (1 << 24)
@@ -164,7 +165,8 @@ def _phase_limbs(delays, sample_time, t):
     ``A = tau / (tsamp * T)``.  ``A mod 1`` is quantised to 36 bits
     (float64 is exact here) and split into three 12-bit limbs so the
     device can form ``k * A mod 1`` with wrapping int32 products —
-    phase error <= 2pi * T/2 * 2^-37 ~ 4e-7 rad even at T = 2^20.
+    phase error <= 2pi * (T/2) * 2^-37 cycles-rounding ~ 2.4e-5 rad at
+    T = 2^20 (it grows linearly with T: ~1.5e-3 rad by T = 2^26).
 
     Returns int32 ``(3, ndm, nchan)``.
     """

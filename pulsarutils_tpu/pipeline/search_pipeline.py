@@ -197,31 +197,34 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     from concurrent.futures import ThreadPoolExecutor
 
     def read_at(s):
-        block = reader.read_block(s, min(plan.step, nsamples - s),
-                                  band_ascending=True)
-        # start the host->device transfer ON the reader thread (device_put
-        # is async and thread-safe): the upload of chunk k+1 then overlaps
-        # the search of chunk k — on slow links the transfer dominates the
-        # whole stream.  COST: peak HBM carries one extra raw chunk
-        # (chunk k+1's buffer coexists with chunk k's pipeline); chunk
-        # sizing already leaves that headroom (a raw chunk is small next
-        # to the captured plane), and a device OOM here degrades to
-        # host cleaning rather than failing the run.
-        # ``device_clean`` is read at call time, so once the main loop
-        # disables device cleaning no more uploads start.  The raw host
-        # block is always returned too: the fallback path must never
-        # depend on a possibly-poisoned device buffer.
-        if device_clean is not None:
-            try:
-                import jax
+        return reader.read_block(s, min(plan.step, nsamples - s),
+                                 band_ascending=True)
 
-                return block, jax.device_put(np.ascontiguousarray(block))
-            except Exception:  # upload failure surfaces on the main path
-                return block, None
-        return block, None
+    def prefetch_upload(read_future):
+        """Start the async device transfer of the NEXT chunk (main thread).
+
+        Called right before the current chunk's (blocking) search: by then
+        the reader thread has usually finished decoding chunk k+1, so its
+        host->device transfer proceeds while the device searches chunk k —
+        on slow links the transfer dominates the whole stream.  COST: peak
+        HBM briefly carries one extra raw chunk; a failure here is
+        non-fatal (the main path re-uploads).  All device ops stay on the
+        main thread — a transfer started from the reader thread deadlocks
+        the tunnelled (axon) client.
+        """
+        if device_clean is None or read_future is None \
+                or not read_future.done():
+            return None
+        try:
+            import jax
+
+            return jax.device_put(read_future.result())
+        except Exception:
+            return None
 
     reader_pool = ThreadPoolExecutor(max_workers=1)
     next_read = reader_pool.submit(read_at, todo[0]) if todo else None
+    array_dev = None  # chunk's prefetched device buffer (if any)
     try:
         for ichunk, istart in enumerate(todo):
             chunk_size = min(plan.step, nsamples - istart)
@@ -229,7 +232,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             t0 = istart * sample_time
 
             with with_timer("read"):
-                array, array_dev = next_read.result()
+                array = next_read.result()
             next_read = (reader_pool.submit(read_at, todo[ichunk + 1])
                          if ichunk + 1 < len(todo) else None)
             with with_timer("clean"):
@@ -260,6 +263,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 nbin=array.shape[1], nchan=array.shape[0], date=date, t0=t0,
                 istart=istart,
                 pulse_freq=1.0 / (array.shape[1] * eff_tsamp))
+
+            # overlap: start chunk k+1's async upload before chunk k's
+            # blocking search (see prefetch_upload)
+            array_dev = prefetch_upload(next_read)
 
             with with_timer("search"):
                 result = _search_with_fallback(
@@ -330,6 +337,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         t0=t0)
 
             store.mark_done(istart)
+            # second prefetch window: by the end of the iteration the
+            # reader has had the whole search/persist to finish decoding
+            # chunk k+1, so this attempt usually fires even when the
+            # pre-search one found the read still in flight
+            if array_dev is None:
+                array_dev = prefetch_upload(next_read)
             nproc += 1
             if progress and nproc % 50 == 0:
                 logger.info("processed %d chunks (through sample %d/%d)",
