@@ -134,6 +134,36 @@ def _fdmt_odd():
     assert plane.shape == (t.nrows, 3000), plane.shape
 
 
+@check("streaming pipeline end-to-end on TPU (device clean + fdmt + sift)")
+def _streaming_pipeline():
+    import os
+    import tempfile
+
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+    from pulsarutils_tpu.pipeline.sift import sift_hits
+
+    with tempfile.TemporaryDirectory() as d:
+        # 120000-sample chunks pinned the conv-compile hang; keep an
+        # awkward (non-power-of-two) total so the regression stays covered
+        array, header = simulate_test_data(150, nchan=64, nsamples=60000,
+                                           signal=2.0, noise=0.4, rng=19)
+        path = os.path.join(d, "s.fil")
+        write_simulated_filterbank(path, array, header)
+        hits, _ = search_by_chunks(path, dmmin=100, dmmax=200,
+                                   backend="jax", kernel="fdmt",
+                                   chunk_length=10.0, make_plots=False,
+                                   resume=False, progress=False,
+                                   output_dir=os.path.join(d, "out"))
+        assert hits, "no hits"
+        sifted = sift_hits(hits)
+        assert len(sifted) == 1, [(c["time"], c["dm"]) for c in sifted]
+        assert abs(sifted[0]["dm"] - 150) <= 2.0, sifted[0]["dm"]
+        t_true = 30000 * header["tsamp"]
+        assert abs(sifted[0]["time"] - t_true) <= 0.1, sifted[0]["time"]
+
+
 @check("plane capture device-resident + period search consumes it")
 def _plane_period():
     import jax.numpy as jnp
