@@ -145,8 +145,10 @@ def _streaming_pipeline():
     from pulsarutils_tpu.pipeline.sift import sift_hits
 
     with tempfile.TemporaryDirectory() as d:
-        # 120000-sample chunks pinned the conv-compile hang; keep an
-        # awkward (non-power-of-two) total so the regression stays covered
+        # awkward (non-power-of-two) 20000-sample chunks: the conv-compile
+        # hang hit non-power-of-two chunk shapes (observed at 120000; this
+        # smaller odd shape exercises the same FFT-convolution code path
+        # that replaced xp.convolve, at smoke-friendly cost)
         array, header = simulate_test_data(150, nchan=64, nsamples=60000,
                                            signal=2.0, noise=0.4, rng=19)
         path = os.path.join(d, "s.fil")
