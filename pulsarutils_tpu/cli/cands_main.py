@@ -1,0 +1,105 @@
+"""``PUcands`` — list, sift and export stored candidates.
+
+The reference left its per-chunk pickles (``clean.py:349-351``) for the
+human to sort through; this tool reads a :class:`..io.candidates.
+CandidateStore` directory, collapses duplicate detections per input file
+(:mod:`..pipeline.sift`), and prints or CSV-exports the result.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+
+from ..io.candidates import CandidateStore
+from ..pipeline.sift import hit_fields, sift_hits
+from ..utils.logging_utils import logger
+
+
+def load_hits_by_root(directory):
+    """Stored candidates grouped by input-file root: ``{root: [(istart,
+    iend, info, table), ...]}``.  One store directory may hold candidates
+    from several input files (the ledger is per-config); grouping keeps
+    sifting from merging detections across files."""
+    store = CandidateStore(directory)
+    by_root = {}
+    for root, lo, hi in store.candidates():
+        info, table = store.load_candidate(root, lo, hi)
+        by_root.setdefault(root, []).append((lo, hi, info, table))
+    return by_root
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        description="List/export candidates from a search output directory")
+    parser.add_argument("directory", help="search --output-dir path")
+    parser.add_argument("--no-sift", action="store_true",
+                        help="list raw per-chunk detections instead of "
+                             "sifted candidates")
+    parser.add_argument("--min-snr", type=float, default=None,
+                        help="drop candidates below this S/N")
+    parser.add_argument("--csv", default=None, metavar="FILE",
+                        help="also write the listing as CSV ('-' = stdout)")
+    return parser
+
+
+def main(args=None):
+    opts = build_parser().parse_args(args)
+    if not os.path.isdir(opts.directory):
+        logger.error("not a directory: %s", opts.directory)
+        return 1
+    by_root = load_hits_by_root(opts.directory)
+    if not by_root:
+        logger.info("no candidates in %s", opts.directory)
+        return 0
+
+    cands = []
+    nstored = 0
+    for root, hits in sorted(by_root.items()):
+        nstored += len(hits)
+        if opts.no_sift:
+            group = [dict(hit_fields(*h), n_members=1) for h in hits]
+        else:
+            group = sift_hits(hits)
+        for c in group:
+            c["file"] = root
+        cands.extend(group)
+    cands.sort(key=lambda c: -c["snr"])
+    if opts.min_snr is not None:
+        cands = [c for c in cands if c["snr"] >= opts.min_snr]
+
+    for c in cands:
+        extra = ""
+        info = c["info"]
+        if getattr(info, "period_freq", None):
+            extra = (f"  periodic f={info.period_freq:.4f} Hz "
+                     f"sigma={info.period_sigma:.1f}")
+        logger.info("%s: t=%.4fs DM=%.2f snr=%.2f width=%.4gs chunk=%d-%d "
+                    "(%d detections)%s", c["file"], c["time"], c["dm"],
+                    c["snr"], c["width"], c["istart"], c["iend"],
+                    c["n_members"], extra)
+    logger.info("%d candidate(s) (%d stored detections)", len(cands),
+                nstored)
+
+    if opts.csv:
+        fields = ["file", "time", "dm", "snr", "width", "istart", "iend",
+                  "n_members"]
+        out = sys.stdout if opts.csv == "-" else open(opts.csv, "w",
+                                                      newline="")
+        try:
+            w = csv.DictWriter(out, fieldnames=fields, extrasaction="ignore")
+            w.writeheader()
+            for c in cands:
+                w.writerow(c)
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        if opts.csv != "-":
+            logger.info("wrote %s", opts.csv)
+    return 0
+
+
+if __name__ == "__main__":  # python -m pulsarutils_tpu.cli.cands_main
+    sys.exit(main())

@@ -17,10 +17,10 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["sift_hits", "sift_candidates"]
+__all__ = ["sift_hits", "sift_candidates", "hit_fields"]
 
 
-def _hit_fields(istart, iend, info, table):
+def hit_fields(istart, iend, info, table):
     """Arrival time (s), DM, S/N and width (s) of one chunk hit."""
     best = table.best_row()
     tsamp = 1.0 / (info.pulse_freq * info.nbin)
@@ -93,7 +93,7 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
     """
     if not hits:
         return []
-    cands = [_hit_fields(*h) for h in hits]
+    cands = [hit_fields(*h) for h in hits]
     if time_radius is None:
         time_radius = 1.5 * max(c["span"] for c in cands)
     if dm_radius is None:

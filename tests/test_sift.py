@@ -51,3 +51,43 @@ def test_sift_hits_collapses_overlap_duplicates(tmp_path):
     # pulse injected at nsamples // 2
     t_true = (16384 // 2) * header["tsamp"]
     assert abs(best["time"] - t_true) <= 0.05
+
+
+def test_pucands_lists_and_exports(tmp_path):
+    # end to end: search -> store -> PUcands listing + CSV export
+    import csv
+    import os
+
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+    from pulsarutils_tpu.cli.cands_main import main as cands_main
+
+    array, header = simulate_test_data(150, nchan=64, nsamples=16384,
+                                       signal=2.0, noise=0.4, rng=5)
+    path = str(tmp_path / "pulse.fil")
+    write_simulated_filterbank(path, array, header)
+    out = str(tmp_path / "out")
+    hits, _ = search_by_chunks(path, dmmin=100, dmmax=200, backend="numpy",
+                               make_plots=False, resume=False,
+                               progress=False, output_dir=out)
+    assert hits
+
+    csv_path = str(tmp_path / "cands.csv")
+    assert cands_main([out, "--csv", csv_path]) == 0
+    with open(csv_path) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 1  # sifted to the single injected pulse
+    assert abs(float(rows[0]["dm"]) - 150) <= 2.0
+    assert int(rows[0]["n_members"]) == len(hits)
+
+    # CSV rows carry the source-file root
+    assert rows[0]["file"] == "pulse"
+
+    # raw listing + S/N floor
+    assert cands_main([out, "--no-sift", "--min-snr", "1e9"]) == 0
+
+    # a nonexistent directory is an error, not a silently created dir
+    missing = str(tmp_path / "nope")
+    assert cands_main([missing]) == 1
+    assert not os.path.exists(missing)
