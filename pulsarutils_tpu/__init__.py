@@ -41,6 +41,7 @@ from .ops.clean_ops import (
     get_noisier_channels,
     measure_channel_variability,
     renormalize_data,
+    zero_dm_filter,
 )
 from .ops.dedisperse import dedisperse, roll_and_sum, apply_dm_shifts_to_data
 from .ops.search import dedispersion_search
@@ -143,6 +144,7 @@ __all__ = [
     "get_noisier_channels",
     "measure_channel_variability",
     "fft_zap_time",
+    "zero_dm_filter",
     "dedisperse",
     "roll_and_sum",
     "apply_dm_shifts_to_data",

@@ -26,7 +26,14 @@ def load_hits_by_root(directory):
     store = CandidateStore(directory)
     by_root = {}
     for root, lo, hi in store.candidates():
-        info, table = store.load_candidate(root, lo, hi)
+        try:
+            info, table = store.load_candidate(root, lo, hi)
+        except (OSError, ValueError, KeyError) as exc:
+            # a search killed between the two record writes leaves an
+            # orphan .info.npz — skip it, keep listing the intact ones
+            logger.warning("skipping unreadable candidate %s_%d-%d: %s",
+                           root, lo, hi, exc)
+            continue
         by_root.setdefault(root, []).append((lo, hi, info, table))
     return by_root
 

@@ -42,6 +42,9 @@ def build_parser():
                         help="excise periodic RFI in the Fourier domain")
     parser.add_argument("--cut-outliers", action="store_true",
                         help="zero broadband outlier time bins")
+    parser.add_argument("--zero-dm", action="store_true",
+                        help="subtract the channel-averaged time series "
+                             "(broadband un-dispersed RFI filter)")
     parser.add_argument("--output-dir", default=None)
     parser.add_argument("--plots", choices=("hits", "all", "none"),
                         default="hits")
@@ -98,6 +101,7 @@ def main(args=None):
             resume=not opts.no_resume,
             fft_zap=opts.fft_zap,
             cut_outliers=opts.cut_outliers,
+            zero_dm=opts.zero_dm,
             max_chunks=opts.max_chunks,
             period_search=opts.period_search,
             period_sigma_threshold=opts.period_sigma,

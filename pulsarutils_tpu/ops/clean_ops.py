@@ -109,6 +109,28 @@ def uniform_filter_1d(x, size, xp=np):
 # Channel flagging
 # ---------------------------------------------------------------------------
 
+def zero_dm_filter(array, badchans_mask=None, xp=np):
+    """Subtract the per-sample mean over (good) channels — the classic
+    "zero-DM" broadband-RFI filter (Eatough, Keane & Lyne 2009).
+
+    Terrestrial interference arrives un-dispersed, so it sits at DM 0:
+    removing the channel-averaged time series cancels it while a
+    dispersed pulse (spread across samples per channel) loses only
+    ``~nchan_occupied/nchan`` of its power.  No reference counterpart —
+    the reference's excision is purely spectral-statistics based
+    (``stats.py``/``clean.py``); this complements it for impulsive
+    broadband RFI.  Pure / jit-compatible.
+    """
+    array = xp.asarray(array)
+    nchan = array.shape[0]
+    if badchans_mask is None:
+        badchans_mask = xp.zeros(nchan, dtype=bool)
+    good = ~xp.asarray(badchans_mask)
+    ngood = xp.maximum(good.sum(), 1)
+    mean_t = xp.where(good[:, None], array, 0.0).sum(axis=0) / ngood
+    return xp.where(good[:, None], array - mean_t[None, :], array)
+
+
 def get_noisier_channels(array, medfilt_size=7, nsigma=5.0, xp=np):
     """Flag channels whose mean lies above a median-filtered bandpass by
     ``nsigma`` reference-MADs (reference ``clean.py:58-67``)."""

@@ -249,8 +249,11 @@ def _transform_setup(data, use_pallas):
 
 #: output rows processed per merge-kernel grid step; amortises the
 #: per-step Pallas/DMA orchestration overhead (the kernel is otherwise
-#: grid-overhead-bound: one row per step = ~1.4M steps per transform)
-MERGE_ROW_BLOCK = 16
+#: grid-overhead-bound: one row per step = ~1.4M steps per transform).
+#: Swept on v5e at the 1024x1M headline: 8/16/32 are within noise on
+#: steady-state (0.54-0.59 s) but 8 compiles several times faster and
+#: 64 exhausts VMEM; tile size dominates instead (8192 >> 4096 >> 2048).
+MERGE_ROW_BLOCK = 8
 
 
 @functools.lru_cache(maxsize=64)

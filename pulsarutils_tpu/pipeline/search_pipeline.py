@@ -26,7 +26,7 @@ import numpy as np
 
 from ..io.candidates import CandidateStore, config_fingerprint
 from ..io.sigproc import FilterbankReader
-from ..ops.clean_ops import fft_zap_time, renormalize_data
+from ..ops.clean_ops import (fft_zap_time, renormalize_data, zero_dm_filter)
 from ..ops.rebin import quick_resample
 from ..ops.search import dedispersion_search
 from ..parallel.stream import iter_chunk_starts, plan_chunks
@@ -88,8 +88,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      dmmin=200, dmmax=800, surelybad=(), *, backend="jax",
                      kernel="auto", snr_threshold=6.0, output_dir=None,
                      make_plots="hits", resume=True, fft_zap=False,
-                     cut_outliers=False, max_chunks=None, progress=True,
-                     period_search=False, period_sigma_threshold=8.0):
+                     cut_outliers=False, zero_dm=False, max_chunks=None,
+                     progress=True, period_search=False,
+                     period_sigma_threshold=8.0):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -150,7 +151,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         fname=os.path.abspath(str(fname)), dmmin=dmmin, dmmax=dmmax,
         step=plan.step, resample=plan.resample, backend=backend,
         kernel=kernel, snr_threshold=snr_threshold, fft_zap=fft_zap,
-        cut_outliers=cut_outliers, surelybad=sorted(int(c) for c in surelybad),
+        cut_outliers=cut_outliers,
+        # only fingerprint zero_dm when it changes the result: adding the
+        # key unconditionally would orphan every pre-existing resume
+        # ledger for plain runs
+        **({"zero_dm": True} if zero_dm else {}),
+        surelybad=sorted(int(c) for c in surelybad),
         period_search=bool(period_search),
         period_sigma_threshold=float(period_sigma_threshold))
     store = CandidateStore(output_dir, fingerprint if resume else None)
@@ -165,6 +171,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     def _clean(block, m, xp=np):
         cleaned = renormalize_data(block, badchans_mask=m,
                                    cut_outliers=cut_outliers, xp=xp)
+        if zero_dm:
+            cleaned = zero_dm_filter(cleaned, badchans_mask=m, xp=xp)
         if fft_zap:
             cleaned, _ = fft_zap_time(cleaned, xp=xp)
         if plan.resample > 1:

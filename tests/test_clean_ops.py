@@ -170,3 +170,38 @@ def test_fft_zap_jax_matches_numpy():
     c_j, z_j = fft_zap_time(jnp.asarray(array), xp=jnp)
     assert np.array_equal(np.asarray(z_j), z_np)
     assert np.allclose(np.asarray(c_j), c_np, atol=1e-3)
+
+
+def test_zero_dm_filter_removes_broadband_keeps_dispersed():
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.ops.clean_ops import zero_dm_filter
+
+    rng = np.random.default_rng(29)
+    nchan, t = 32, 2048
+    noise = rng.normal(0, 0.1, (nchan, t))
+    # broadband un-dispersed spike + a dispersed pulse
+    rfi = np.zeros((nchan, t))
+    rfi[:, 500] = 10.0
+    pulse = np.zeros((nchan, t))
+    pulse[:, 1200] = 5.0
+    pulse = disperse_array(pulse, 150, 1200.0, 200.0, 0.0005)
+    data = noise + rfi + pulse
+
+    out = zero_dm_filter(data)
+    # the un-dispersed spike column is cancelled to noise level
+    assert np.abs(out[:, 500]).max() < 1.0
+    # the dispersed pulse loses only ~1/nchan of its power
+    peak_per_chan = out[pulse > 4.0]
+    assert (peak_per_chan > 4.0).all()
+
+    # bad channels pass through untouched; jax path matches numpy
+    mask = np.zeros(nchan, dtype=bool)
+    mask[3] = True
+    out_m = zero_dm_filter(data, badchans_mask=mask)
+    assert np.array_equal(out_m[3], data[3])
+    out_j = np.asarray(zero_dm_filter(jnp.asarray(data.astype(np.float32)),
+                                      badchans_mask=jnp.asarray(mask),
+                                      xp=jnp))
+    assert np.allclose(out_j, out_m, atol=1e-3)
