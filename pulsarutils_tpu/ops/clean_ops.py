@@ -109,6 +109,12 @@ def uniform_filter_1d(x, size, xp=np):
 # Channel flagging
 # ---------------------------------------------------------------------------
 
+def _masked_channel_mean(array, good, xp):
+    """Per-sample mean over the good channels (shared by the cleaners)."""
+    ngood = xp.maximum(good.sum(), 1)
+    return xp.where(good[:, None], array, 0.0).sum(axis=0) / ngood
+
+
 def zero_dm_filter(array, badchans_mask=None, xp=np):
     """Subtract the per-sample mean over (good) channels — the classic
     "zero-DM" broadband-RFI filter (Eatough, Keane & Lyne 2009).
@@ -126,8 +132,7 @@ def zero_dm_filter(array, badchans_mask=None, xp=np):
     if badchans_mask is None:
         badchans_mask = xp.zeros(nchan, dtype=bool)
     good = ~xp.asarray(badchans_mask)
-    ngood = xp.maximum(good.sum(), 1)
-    mean_t = xp.where(good[:, None], array, 0.0).sum(axis=0) / ngood
+    mean_t = _masked_channel_mean(array, good, xp)
     return xp.where(good[:, None], array - mean_t[None, :], array)
 
 
@@ -194,8 +199,7 @@ def renormalize_data(array, badchans_mask=None, baseline_window=101,
     badchans_mask = xp.asarray(badchans_mask)
     good = ~badchans_mask
 
-    ngood = xp.maximum(good.sum(), 1)
-    lc = xp.where(good[:, None], array, 0.0).sum(axis=0) / ngood
+    lc = _masked_channel_mean(array, good, xp)
     window = min(int(baseline_window), nsamples // 100 * 2 + 1)
     lc_smooth = gaussian_filter_1d(lc, window, xp=xp)
     lc_smooth = xp.where(lc_smooth == 0, 1.0, lc_smooth)

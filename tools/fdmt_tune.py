@@ -36,13 +36,13 @@ def main(argv):
     print(f"config: {nchan} x {nsamp}, {ndm} trials", flush=True)
 
     tiles_default = (8192, 4096, 2048, 1024)
+    row_block_orig = fdmt.MERGE_ROW_BLOCK
     for row_block in (8, 16, 32, 64):
         for tiles in (tiles_default, (4096, 2048, 1024), (2048, 1024)):
             fdmt.MERGE_ROW_BLOCK = row_block
-            fdmt._pick = lambda t, _tiles=tiles: next(
-                (tt for tt in _tiles if t % tt == 0), 0)
             orig = fdmt._pick_fdmt_tile
-            fdmt._pick_fdmt_tile = fdmt._pick
+            fdmt._pick_fdmt_tile = lambda t, _tiles=tiles: next(
+                (tt for tt in _tiles if t % tt == 0), 0)
             # drop caches so the knobs take effect
             fdmt._build_transform.cache_clear()
             fdmt._build_merge_kernel.cache_clear()
@@ -63,6 +63,10 @@ def main(argv):
                       f"FAILED {type(exc).__name__}: {exc}", flush=True)
             finally:
                 fdmt._pick_fdmt_tile = orig
+    # restore module state for long-lived importers
+    fdmt.MERGE_ROW_BLOCK = row_block_orig
+    fdmt._build_transform.cache_clear()
+    fdmt._build_merge_kernel.cache_clear()
 
 
 if __name__ == "__main__":
