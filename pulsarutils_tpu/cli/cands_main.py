@@ -91,8 +91,8 @@ def main(args=None):
                 nstored)
 
     if opts.csv:
-        fields = ["file", "time", "dm", "snr", "width", "istart", "iend",
-                  "n_members"]
+        fields = ["file", "time", "time_approx", "dm", "snr", "width",
+                  "istart", "iend", "n_members"]
         out = sys.stdout if opts.csv == "-" else open(opts.csv, "w",
                                                       newline="")
         try:

@@ -59,8 +59,18 @@ def _build_library():
             if (os.path.exists(out)
                     and os.path.getmtime(out) >= os.path.getmtime(_SRC)):
                 return out
-            cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", out, _SRC]
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            # compile to a unique temp path and rename into place: rename
+            # is atomic on POSIX, so a concurrent process never CDLLs a
+            # half-written (yet ELF-parsable) library
+            tmp = f"{out}.tmp{os.getpid()}"
+            try:
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(tmp, out)
+            finally:
+                if os.path.exists(tmp):  # failed build: no orphan files
+                    os.unlink(tmp)
             return out
         except (OSError, subprocess.SubprocessError) as exc:
             logger.debug("native unpack build failed in %s: %s", d, exc)

@@ -35,6 +35,11 @@ def hit_fields(istart, iend, info, table):
     if "peak" in table.colnames:
         t_peak = t_peak + float(best["peak"]) * tsamp
     return {
+        # the istart * tsamp fallback mixes units whenever the pipeline
+        # resampled (file-sample index x effective sample time); flag it
+        # so consumers (CSV export, sifting radii) know the time is
+        # best-effort, not exact
+        "time_approx": t0 is None,
         "istart": int(istart),
         "iend": int(iend),
         # chunk duration in seconds: nbin is the post-resample sample
@@ -51,23 +56,28 @@ def hit_fields(istart, iend, info, table):
     }
 
 
-def sift_candidates(cands, time_radius, dm_radius):
+def sift_candidates(cands, time_radius, dm_radius=None):
     """Group candidate dicts (keys ``time, dm, snr``) and keep each group's
     best.
 
     Greedy single-linkage in descending S/N order: a candidate joins the
-    first kept group within ``time_radius`` seconds AND ``dm_radius`` DM
-    units; otherwise it seeds a new group.  Returns the kept candidates
-    (descending S/N), each annotated with ``n_members`` — the number of
-    raw detections it absorbed.
+    first kept group within ``time_radius`` seconds AND the group's DM
+    radius; otherwise it seeds a new group.  ``dm_radius=None`` (default)
+    derives the radius from each group's *seed* DM (``0.02 * seed_dm + 1``
+    — trial-grid spacing grows with DM), so one high-DM candidate cannot
+    inflate the merge radius of every low-DM group.  Returns the kept
+    candidates (descending S/N), each annotated with ``n_members`` — the
+    number of raw detections it absorbed.
     """
     order = sorted(range(len(cands)), key=lambda i: -cands[i]["snr"])
     kept = []
     for i in order:
         c = cands[i]
         for k in kept:
+            k_radius = (0.02 * k["dm"] + 1.0 if dm_radius is None
+                        else dm_radius)
             if (abs(c["time"] - k["time"]) <= time_radius
-                    and abs(c["dm"] - k["dm"]) <= dm_radius):
+                    and abs(c["dm"] - k["dm"]) <= k_radius):
                 k["n_members"] += 1
                 break
         else:
@@ -85,8 +95,8 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
     holding only part of a pulse detect its *circular-wrap artifact* up
     to a chunk span (+ its width) away (the roll convention wraps the
     dispersed tail, reference ``dedispersion.py:60-98``); ``dm_radius`` =
-    2% of the best DM + 1 (trial-grid neighbours and chunk-to-chunk
-    jitter).
+    per group, 2% of the group seed's DM + 1 (trial-grid neighbours and
+    chunk-to-chunk jitter — see :func:`sift_candidates`).
 
     Returns a list of candidate dicts (descending S/N) with keys
     ``time, dm, snr, width, istart, iend, n_members, info, table``.
@@ -96,6 +106,4 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
     cands = [hit_fields(*h) for h in hits]
     if time_radius is None:
         time_radius = 1.5 * max(c["span"] for c in cands)
-    if dm_radius is None:
-        dm_radius = 0.02 * max(c["dm"] for c in cands) + 1.0
     return sift_candidates(cands, time_radius, dm_radius)

@@ -17,6 +17,25 @@ def test_sift_candidates_groups_by_radius():
     assert sorted(k["snr"] for k in kept) == [7.0, 9.0, 12.0]
 
 
+def test_sift_per_group_dm_radius():
+    # a single high-DM candidate must NOT inflate the merge radius of
+    # low-DM groups: two distinct low-DM events 8 DM units apart stay
+    # separate even with a DM-2000 candidate in the list (the old global
+    # radius 0.02 * 2000 + 1 = 41 would wrongly merge them)
+    cands = [
+        {"time": 1.00, "dm": 100.0, "snr": 9.0},
+        {"time": 1.01, "dm": 108.0, "snr": 8.0},   # distinct low-DM event
+        {"time": 50.0, "dm": 2000.0, "snr": 12.0},
+    ]
+    kept = sift_candidates(cands, time_radius=0.1)
+    assert len(kept) == 3
+    # but trial-grid neighbours of one event still merge
+    cands[1]["dm"] = 101.5
+    kept = sift_candidates(cands, time_radius=0.1)
+    assert len(kept) == 2
+    assert kept[1]["n_members"] == 2
+
+
 def test_sift_candidates_descending_snr_and_empty():
     assert sift_candidates([], 1.0, 1.0) == []
     cands = [{"time": t, "dm": 100.0, "snr": s}
