@@ -63,7 +63,7 @@ def _lam(f):
 
 
 class FdmtPlan:
-    """Static merge schedule for one (nchan, geometry, max_delay) triple.
+    """Static merge schedule for one (nchan, geometry, delay-range) tuple.
 
     Attributes
     ----------
@@ -78,11 +78,21 @@ class FdmtPlan:
     nchan_padded : channel count rounded up to a power of two (the extra
         channels are zero and contribute nothing).
     max_delay : largest differential band delay (inclusive) produced.
+    min_delay : smallest band delay produced (DM-range pruning): the final
+        state holds rows ``min_delay..max_delay`` only, and every earlier
+        iteration allocates just the (contiguous) parent-delay window
+        those rows reach through the recursion — for a search restricted
+        to DM 300-635 (the benchmark config) this nearly halves the tree's
+        rows, HBM traffic and adds versus the classic 0-anchored transform.
     """
 
-    def __init__(self, nchan, start_freq, bandwidth, max_delay):
+    def __init__(self, nchan, start_freq, bandwidth, max_delay, min_delay=0):
         self.nchan = nchan
         self.max_delay = int(max_delay)
+        self.min_delay = int(min_delay)
+        if not 0 <= self.min_delay <= self.max_delay:
+            raise ValueError(
+                f"min_delay {min_delay} outside [0, {max_delay}]")
         nch2 = 1
         while nch2 < nchan:
             nch2 *= 2
@@ -108,7 +118,10 @@ class FdmtPlan:
         # State rows: band-major, delay-minor, nd[b] slots for band b.
 
         # pass A (top-down): per-iteration band split fractions, then the
-        # maximum delay index each band is ever asked for
+        # (contiguous) delay window each band is ever asked for.  Both the
+        # min and max of the window propagate: dd increasing by 1 moves
+        # dh = round(dd * frac) and dl = dd - dh by 0 or 1 each, so the
+        # parent windows of a contiguous child window are contiguous too.
         widths = []
         w = 1
         while w < nch2:
@@ -125,23 +138,32 @@ class FdmtPlan:
                 fr[b] = w12 / w02 if w02 > 0 else 0.0
             fracs.append(fr)
         used = [None] * (len(widths) + 1)
-        used[-1] = np.asarray([maxn])  # final band serves Δ = 0..maxn
+        used_min = [None] * (len(widths) + 1)
+        used[-1] = np.asarray([maxn])  # final band serves Δ = minn..maxn
+        used_min[-1] = np.asarray([self.min_delay])
         for i in range(len(widths) - 1, 0, -1):
-            u_out = used[i + 1]
+            u_out, u_out_min = used[i + 1], used_min[i + 1]
             nb = len(u_out)
             u_in = np.zeros(2 * nb, np.int64)
+            u_in_min = np.zeros(2 * nb, np.int64)
             for b in range(nb):
-                dd = np.arange(u_out[b] + 1)
+                dd = np.arange(u_out_min[b], u_out[b] + 1)
                 dh = np.round(dd * fracs[i][b]).astype(np.int64)
-                u_in[2 * b] = (dd - dh).max(initial=0)
-                u_in[2 * b + 1] = dh.max(initial=0)
-            used[i] = u_in
+                dl = dd - dh
+                u_in[2 * b], u_in_min[2 * b] = dl.max(), dl.min()
+                u_in[2 * b + 1], u_in_min[2 * b + 1] = dh.max(), dh.min()
+            used[i], used_min[i] = u_in, u_in_min
 
         # pass B (bottom-up): flat index tables over the allocated rows
+        # (row layout: band-major, delay-minor, band b holding delays
+        # used_min[b]..used[b] inclusive)
         self.iterations = []
-        nd_in = [1] * nch2  # the raw channels
+        nd_in = [1] * nch2       # the raw channels
+        min_in = [0] * nch2
         for i, w in enumerate(widths):
-            nd_out = [int(u) + 1 for u in used[i + 1]]
+            u_out, u_out_min = used[i + 1], used_min[i + 1]
+            nd_out = [int(u_out[b] - u_out_min[b]) + 1
+                      for b in range(len(u_out))]
             in_off = np.concatenate([[0], np.cumsum(nd_in)])
             out_rows = int(np.sum(nd_out))
             idx_low = np.empty(out_rows, np.int32)
@@ -150,7 +172,7 @@ class FdmtPlan:
             shift_high = np.zeros(out_rows, np.int32) if i == 0 else None
             pos = 0
             for b in range(len(nd_out)):
-                dd = np.arange(nd_out[b])
+                dd = np.arange(u_out_min[b], u_out[b] + 1)
                 dh = np.round(dd * fracs[i][b]).astype(np.int64)
                 dl = dd - dh
                 if i == 0:
@@ -162,10 +184,15 @@ class FdmtPlan:
                     shift[pos:pos + len(dd)] = dd
                     shift_high[pos:pos + len(dd)] = dh
                 else:
-                    assert dh.max(initial=0) < nd_in[2 * b + 1], (i, b)
-                    assert dl.max(initial=0) < nd_in[2 * b], (i, b)
-                    idx_low[pos:pos + len(dd)] = in_off[2 * b] + dl
-                    idx_high[pos:pos + len(dd)] = in_off[2 * b + 1] + dh
+                    assert dh.min() >= min_in[2 * b + 1], (i, b)
+                    assert dh.max() - min_in[2 * b + 1] < nd_in[2 * b + 1], \
+                        (i, b)
+                    assert dl.min() >= min_in[2 * b], (i, b)
+                    assert dl.max() - min_in[2 * b] < nd_in[2 * b], (i, b)
+                    idx_low[pos:pos + len(dd)] = (in_off[2 * b]
+                                                  + dl - min_in[2 * b])
+                    idx_high[pos:pos + len(dd)] = (in_off[2 * b + 1]
+                                                   + dh - min_in[2 * b + 1])
                     shift[pos:pos + len(dd)] = dh
                 pos += len(dd)
             self.iterations.append({
@@ -177,12 +204,13 @@ class FdmtPlan:
                 "ndelay": nd_out,
             })
             nd_in = nd_out
+            min_in = [int(m) for m in u_out_min]
 
 
 @functools.lru_cache(maxsize=32)
-def fdmt_plan(nchan, start_freq, bandwidth, max_delay):
+def fdmt_plan(nchan, start_freq, bandwidth, max_delay, min_delay=0):
     """Cached :class:`FdmtPlan` (all-static inputs)."""
-    return FdmtPlan(nchan, start_freq, bandwidth, max_delay)
+    return FdmtPlan(nchan, start_freq, bandwidth, max_delay, min_delay)
 
 
 def max_band_delay(nchan, dmmax, start_freq, bandwidth, sample_time):
@@ -250,10 +278,12 @@ def _transform_setup(data, use_pallas):
 #: output rows processed per merge-kernel grid step; amortises the
 #: per-step Pallas/DMA orchestration overhead (the kernel is otherwise
 #: grid-overhead-bound: one row per step = ~1.4M steps per transform).
-#: Swept on v5e at the 1024x1M headline: 8/16/32 are within noise on
-#: steady-state (0.54-0.59 s) but 8 compiles several times faster and
-#: 64 exhausts VMEM; tile size dominates instead (8192 >> 4096 >> 2048).
-MERGE_ROW_BLOCK = 8
+#: Re-swept on v5e at the 1024x1M headline with the DM-pruned plan
+#: (tools/fdmt_tune.py): 32 @ tile 8192 = 0.352 s (1454 tr/s) vs 8 =
+#: 0.394 s; 64 @ 8192 exhausts scoped VMEM; tile size still dominates
+#: (8192 >> 4096 >> 2048).  Compile is slower at 32 (~25 s cold) but the
+#: persistent compilation cache amortises it.
+MERGE_ROW_BLOCK = 32
 
 
 @functools.lru_cache(maxsize=64)
@@ -278,8 +308,9 @@ def _build_merge_kernel(rows_out, rows_in, t, t_tile, k_tiles, k_tiles_h,
     n_t = t // t_tile
     kh = max(1, k_tiles_h)
 
-    def shifted_tile(win_ref, r, lane, jnp, pl, pltpu):
-        return shifted_row_tile(win_ref, None, r, L, lane, jnp, pl, pltpu)
+    def shifted_tile(win_ref, r, lane, jnp, pl, pltpu, q0):
+        return shifted_row_tile(win_ref, None, r, L, lane, jnp, pl, pltpu,
+                                q0=q0)
 
     def kernel(idx_low_ref, idx_high_ref, shift_ref, shift_high_ref,
                *refs):
@@ -298,13 +329,13 @@ def _build_merge_kernel(rows_out, rows_in, t, t_tile, k_tiles, k_tiles_h,
             for k in range(k_tiles):
                 win_ref[k * 8:(k + 1) * 8, :] = low_refs[k][0, 0]
             low_tile = shifted_tile(win_ref, shift_ref[i_r * row_block + j],
-                                    lane, jnp, pl, pltpu)
+                                    lane, jnp, pl, pltpu, k_tiles == 2)
             if k_tiles_h:
                 for k in range(k_tiles_h):
                     win_h_ref[k * 8:(k + 1) * 8, :] = high_refs[k][0, 0]
                 high_tile = shifted_tile(
                     win_h_ref, shift_high_ref[i_r * row_block + j], lane,
-                    jnp, pl, pltpu)
+                    jnp, pl, pltpu, k_tiles_h == 2)
             else:
                 high_tile = high_refs[0][0, 0]
             out_ref[j, 0] = high_tile + low_tile
@@ -397,17 +428,19 @@ def _merge_pallas(state, it, t_tile, interpret):
 def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                      use_pallas, interpret, n_lo=0, with_scores=False,
                      with_plane=True, t_orig=None):
-    """One jitted program: merges [+ slice to rows n_lo.. + scoring].
+    """One jitted program: DM-pruned merges [+ scoring].
 
-    Fusing the row slice and the scorer into the program keeps the live
-    set between calls near zero — returning the full (max_delay+1, T)
-    state keeps gigabytes alive and OOMs back-to-back searches at the
-    1M-sample size.
+    The plan is built with ``min_delay = n_lo`` (see :class:`FdmtPlan`),
+    so rows below the searched DM range are never computed — the final
+    state IS rows ``n_lo..max_delay``.  Fusing the scorer into the
+    program keeps the live set between calls near zero — returning the
+    full state keeps gigabytes alive and OOMs back-to-back searches at
+    the 1M-sample size.
     """
     import jax
     import jax.numpy as jnp
 
-    plan = fdmt_plan(nchan, start_freq, bandwidth, max_delay)
+    plan = fdmt_plan(nchan, start_freq, bandwidth, max_delay, n_lo)
 
     def fn(data):
         state = data
@@ -424,7 +457,7 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                 state = _merge_xla(state, jnp.asarray(it["idx_low"]),
                                    jnp.asarray(it["idx_high"]),
                                    jnp.asarray(it["shift"]), sh)
-        plane = state[n_lo:max_delay + 1]
+        plane = state  # rows n_lo..max_delay by construction
         if t_orig is not None and t_orig != t:
             plane = plane[:, :t_orig]
         if not with_scores:
@@ -443,7 +476,8 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
 # Public transform + search
 # ---------------------------------------------------------------------------
 
-def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None):
+def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None,
+                   min_delay=0):
     """All integer-delay dedispersed series of ``data`` at once.
 
     Parameters
@@ -454,12 +488,14 @@ def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None):
         reference convention ``dedispersion.py:127,135``).
     use_pallas : force the Pallas (True) or XLA (False) merge; default
         auto (Pallas on TPU when a power-of-two tile divides T).
+    min_delay : smallest band delay to compute (DM-range pruning — rows
+        below it are never built; see :class:`FdmtPlan`).
 
     Returns
     -------
-    (max_delay + 1, T) float32 device array: row ``N`` sums one sample
-    per channel along the track with band-crossing delay ``N``, anchored
-    at the top of the band.
+    (max_delay - min_delay + 1, T) float32 device array: row ``i`` sums
+    one sample per channel along the track with band-crossing delay
+    ``min_delay + i``, anchored at the top of the band.
     """
     import jax.numpy as jnp
 
@@ -475,7 +511,7 @@ def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None):
     # consumer has read it.
     run = _build_transform(nchan, float(start_freq), float(bandwidth),
                            int(max_delay), t_run, t_tile, use_pallas,
-                           interpret, t_orig=t_orig)
+                           interpret, n_lo=int(min_delay), t_orig=t_orig)
     return run(data)
 
 

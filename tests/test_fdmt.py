@@ -83,6 +83,24 @@ class TestTransform:
         np.testing.assert_allclose(out[0], data.sum(axis=0), rtol=1e-5,
                                    atol=1e-4)
 
+    def test_dm_range_pruning_matches_full_transform(self):
+        # a min_delay-pruned plan must reproduce the corresponding rows
+        # of the classic 0-anchored transform exactly (same tracks, same
+        # summation order) while allocating fewer rows per iteration
+        rng = np.random.default_rng(4)
+        nchan, t, max_delay, min_delay = 16, 512, 40, 17
+        data = rng.normal(0, 1, (nchan, t)).astype(np.float32)
+        full = np.asarray(fdmt_transform(data, max_delay, GEOM[0], GEOM[1]))
+        pruned = np.asarray(fdmt_transform(data, max_delay, GEOM[0],
+                                           GEOM[1], min_delay=min_delay))
+        assert pruned.shape == (max_delay - min_delay + 1, t)
+        np.testing.assert_array_equal(pruned, full[min_delay:])
+        plan_full = fdmt_plan(nchan, GEOM[0], GEOM[1], max_delay)
+        plan_pruned = fdmt_plan(nchan, GEOM[0], GEOM[1], max_delay,
+                                min_delay)
+        rows = lambda p: sum(len(it["idx_low"]) for it in p.iterations)  # noqa: E731
+        assert rows(plan_pruned) < rows(plan_full)
+
     def test_nonpow2_channels_padded(self):
         rng = np.random.default_rng(3)
         data = rng.normal(0, 1, (12, 256)).astype(np.float32)
