@@ -32,10 +32,12 @@ def build_parser():
     parser.add_argument("--backend", choices=("jax", "numpy"), default="jax")
     parser.add_argument("--kernel",
                         choices=("auto", "pallas", "gather", "fdmt",
-                                 "fourier"),
+                                 "hybrid", "fourier"),
                         default="auto",
                         help="jax-path kernel; fdmt = tree dedispersion "
                              "(fastest dense sweep, tree-rounded tracks); "
+                             "hybrid = FDMT coarse + exact rescore of the "
+                             "hit region (exact hits at near-FDMT speed); "
                              "fourier = exact fractional-sample delays "
                              "(precision option)")
     parser.add_argument("--fft-zap", action="store_true",

@@ -101,7 +101,7 @@ def _kernel_body(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
     jax.lax.fori_loop(0, dm_block, body, 0)
 
 
-def shifted_row_tile(win_ref, c, r, L, lane, jnp, pl, pltpu):
+def shifted_row_tile(win_ref, c, r, L, lane, jnp, pl, pltpu, q0=False):
     """Read ``window[r : r + 8L]`` as an (8, L) chunked tile.
 
     The circular-shift primitive shared by the rows-layout dedispersion
@@ -111,7 +111,18 @@ def shifted_row_tile(win_ref, c, r, L, lane, jnp, pl, pltpu):
     ``q mod 8``, and blend each row with its successor at the ``L - m``
     lane boundary.  ``c`` indexes the leading dim of a 3-D window ref
     (``None`` for a 2-D ref); ``lane`` is a (8, L) lane iota.
+
+    ``q0=True`` is the statically-known ``r < L`` fast path (every offset
+    below one lane row, i.e. halo ``k_tiles == 2``): ``q = 0`` always, so
+    the load base is static and the dynamic sublane rotate — a full
+    16-row VPU op per (trial, channel) — is elided entirely (~1.3-1.5x
+    on the benchmark geometry, whose band-crossing delay is < L = 1024).
     """
+    if q0:
+        rows16 = (win_ref[pl.ds(0, 16), :] if c is None
+                  else win_ref[c, pl.ds(0, 16), :])
+        rolled = pltpu.roll(rows16, (L - r) % L, 1)
+        return jnp.where(lane < L - r, rolled[0:8], rolled[1:9])
     q = r // L
     m = r - q * L
     qa = pl.multiple_of((q // 8) * 8, 8)
@@ -141,6 +152,8 @@ def _kernel_body_rows(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
     out_ref = refs[k_tiles]
     win_ref = refs[k_tiles + 1]
     L = t_tile // 8
+    q0 = k_tiles == 2  # halo of 2 tiles <=> every offset < L (see
+    # _halo_tiles: (off // L + 23) // 8 == 2 iff off // L == 0)
 
     i_c = pl.program_id(2)
 
@@ -158,7 +171,7 @@ def _kernel_body_rows(off_ref, *refs, dm_block, chan_block, t_tile, k_tiles,
         acc = out_ref[d, 0]
         for c in range(chan_block):
             acc = acc + shifted_row_tile(win_ref, c, off_ref[0, 0, d, c],
-                                         L, lane, jnp, pl, pltpu)
+                                         L, lane, jnp, pl, pltpu, q0=q0)
         out_ref[d, 0] = acc
         return carry
 

@@ -369,6 +369,196 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
     return maxvalues, stds, best_snrs, best_windows, best_peaks, plane
 
 
+#: rescore-call row buckets (requested rows pad up to the next bucket);
+#: a small set of static shapes keeps compiles bounded while not paying
+#: the biggest block's VPU cost for a handful of rows
+HYBRID_RESCORE_BUCKETS = (8, 16, 32)
+
+#: hard cap on guarantee-loop iterations before the hybrid falls back to
+#: rescoring every remaining candidate row (correctness is then trivial)
+HYBRID_MAX_ROUNDS = 20
+
+
+@functools.lru_cache(maxsize=16)
+def _fused_rescore_kernel(max_off, dm_block):
+    """One jitted program: Pallas dedisperse (un-rebased output) + score.
+
+    The hybrid's exact-rescore hot path on TPU.  ``max_off`` is the
+    *full* offset table's rebased bound — static and identical for every
+    subset, so all guarantee-loop rounds (and warm/timed bench runs) hit
+    one compiled program per row bucket.  The plane is scored WITHOUT
+    undoing the rebase rotation: max/std/snr/window are
+    rotation-invariant (the rebase constant is 128-aligned, a multiple
+    of every boxcar width, so block sums are a rotation of the reference
+    ones), and the peak index is corrected host-side
+    (``(peak - roll_k) mod T``) — saving a full-plane roll pass and two
+    dispatch round trips per call over the tunnelled link.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .pallas_dedisperse import dedisperse_plane_pallas_traced
+
+    @jax.jit
+    def run(data, offs):
+        plane = dedisperse_plane_pallas_traced(data, offs, max_off,
+                                               dm_block=dm_block)
+        return score_profiles_stacked(plane, xp=jnp)
+
+    return run
+
+
+def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
+                       capture_plane, dm_block, chan_block,
+                       snr_floor=None):
+    """FDMT coarse sweep + exact rescore of the hit region.
+
+    The throughput/exactness trade (VERDICT round 1): the FDMT computes
+    every trial in O(nchan log nchan) passes but its tree-rounded tracks
+    make scores approximate (within ~a trial of the exact kernels); the
+    direct kernels are bit-exact-vs-NumPy but O(ndm * nchan).  This path
+    delivers both at once:
+
+    1. coarse-score ALL plan trials with the FDMT (each plan row takes
+       the S/N of its nearest integer-band-delay FDMT row);
+    2. exactly rescore — same offsets, same scorer, same summation order
+       as the direct kernels — every row whose coarse estimate could be
+       the global best;
+    3. iterate with a margin bound derived from the *observed* coarse
+       error on already-rescored rows until no unrescored row's coarse
+       estimate reaches ``best_exact - margin``.  On exhaustion of the
+       round budget, rescore everything still in question.
+
+    Hit detection (``argbest`` row: DM, snr, rebin, peak) is therefore
+    the exact kernel's — byte-equal to ``kernel="pallas"`` and matching
+    ``backend="numpy"`` wherever the direct kernel does — at a cost of
+    one FDMT pass plus a few dozen exact trials instead of the full
+    O(ndm) sweep.  The returned table carries an ``exact`` bool column
+    marking which rows hold exact scores.
+
+    ``snr_floor`` (opt-in): additionally rescore every row whose coarse
+    S/N reaches ``snr_floor - 0.75``, making *all* above-threshold
+    detections exact, not just the best.  Off by default because it is
+    only affordable when the floor sits clearly above the noise
+    expectation ``~sqrt(2 ln T)`` — at T = 2^20 samples the reference's
+    ``snr > 6`` floor (``clean.py:349``) is a mere 0.5 above the noise
+    max, and chasing it degenerates into a full exact sweep.
+
+    ``capture_plane`` returns the *coarse* (FDMT) plane: the plane is a
+    diagnostics product and the tree rows agree with the exact series up
+    to track rounding and a small circular rotation (:mod:`.fdmt`).
+    """
+    ndm = len(trial_dms)
+    nchan, nsamples = np.shape(data)
+    dmmin = float(np.min(trial_dms))
+    dmmax = float(np.max(trial_dms))
+
+    # 1. coarse sweep (scores for every trial in log-depth passes)
+    (fdmt_dms, c_max, c_std, c_snr, c_win, c_peak, plane) = _search_jax_fdmt(
+        data, dmmin, dmmax, start_freq, bandwidth, sample_time, capture_plane)
+    # nearest coarse row for each plan row (both grids are sorted,
+    # one-sample spacing, offset by < 1 trial)
+    pos = np.searchsorted(fdmt_dms, trial_dms)
+    lo = np.clip(pos - 1, 0, len(fdmt_dms) - 1)
+    hi = np.clip(pos, 0, len(fdmt_dms) - 1)
+    idx = np.where(np.abs(fdmt_dms[lo] - trial_dms)
+                   <= np.abs(fdmt_dms[hi] - trial_dms), lo, hi)
+    if plane is not None and plane.shape[0] != ndm:
+        # align the coarse plane with the plan grid (row gather — cheap,
+        # and row-major on TPU unlike the scalarising lane gather)
+        plane = plane[idx]
+
+    maxvalues = np.asarray(c_max, np.float64)[idx]
+    stds = np.asarray(c_std, np.float64)[idx]
+    snrs = np.asarray(c_snr, np.float64)[idx]
+    windows = np.asarray(c_win, np.int32)[idx]
+    peaks = np.asarray(c_peak, np.int64)[idx]
+    coarse_snrs = snrs.copy()
+    exact = np.zeros(ndm, dtype=bool)
+
+    import jax
+
+    use_fused = jax.default_backend() == "tpu"
+    if use_fused:
+        import jax.numpy as jnp
+
+        from .pallas_dedisperse import rebase_offsets
+
+        offsets_full = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
+                                    sample_time, nsamples)
+        # ONE rebase over the full table: every subset then shares the
+        # same static max_off (one compiled program per bucket) and the
+        # same host-side peak correction constant
+        rebased_full, roll_k, max_off = rebase_offsets(offsets_full,
+                                                       nsamples)
+        data32 = jnp.asarray(data, jnp.float32)
+
+    def _apply(blk, scored):
+        m, s, b, w, p = scored
+        k = len(blk)
+        maxvalues[blk] = m[:k]
+        stds[blk] = s[:k]
+        snrs[blk] = b[:k]
+        windows[blk] = w[:k]
+        peaks[blk] = p[:k]
+        exact[blk] = True
+
+    def rescore(rows):
+        """Exact scores for ``rows`` — fused Pallas+score program on TPU
+        (one dispatch + one readback per bucketed call), the portable
+        gather kernel elsewhere."""
+        rows = np.asarray(rows)
+        top = HYBRID_RESCORE_BUCKETS[-1]
+        for blk_lo in range(0, len(rows), top):
+            blk = rows[blk_lo:blk_lo + top]
+            bucket = next(b for b in HYBRID_RESCORE_BUCKETS
+                          if b >= len(blk))
+            padded = np.concatenate(
+                [blk, blk[-1:].repeat(bucket - len(blk))])
+            if use_fused:
+                run = _fused_rescore_kernel(max_off, bucket)
+                stacked = run(data32, jnp.asarray(rebased_full[padded]))
+                m, s, b_, w, p = unstack_scores(stacked)
+                p = (p - roll_k) % nsamples  # undo the rebase rotation
+                _apply(blk, (m, s, b_, w, p))
+            else:
+                m, s, b_, w, p, _ = _search_jax(
+                    data, trial_dms[padded], start_freq, bandwidth,
+                    sample_time, capture_plane=False, dm_block=dm_block,
+                    chan_block=chan_block, dtype=None, kernel="auto")
+                _apply(blk, (m, s, b_, w, p))
+
+    # 2. seed: plausible-best rows (plus opt-in threshold hits), plus
+    # grid neighbours (the coarse grid sits up to one trial off the plan)
+    seed = (coarse_snrs >= coarse_snrs.max() - 0.5)
+    if snr_floor is not None:
+        seed |= coarse_snrs >= snr_floor - 0.75
+    seed_idx = np.flatnonzero(seed)
+    grown = np.unique(np.clip(seed_idx[:, None]
+                              + np.arange(-1, 2)[None, :], 0, ndm - 1))
+    rescore(grown)
+
+    # 3. guarantee loop: margin = twice the worst coarse error seen so far
+    for _round in range(HYBRID_MAX_ROUNDS):
+        err = np.abs(snrs[exact] - coarse_snrs[exact]).max(initial=0.0)
+        margin = max(2.0 * err, 0.25)
+        best_exact = snrs[exact].max()
+        need = (~exact) & (coarse_snrs >= best_exact - margin)
+        if snr_floor is not None:
+            need |= (~exact) & (coarse_snrs >= snr_floor - 0.75)
+        todo = np.flatnonzero(need)
+        if todo.size == 0:
+            break
+        rescore(todo)
+    else:
+        todo = np.flatnonzero(
+            (~exact) & (coarse_snrs >= snrs[exact].max() - 0.25))
+        if todo.size:
+            rescore(todo)
+
+    return maxvalues, stds, snrs, windows, peaks, exact, plane
+
+
 # ---------------------------------------------------------------------------
 # Public façade
 # ---------------------------------------------------------------------------
@@ -376,7 +566,7 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                         show=False, *, backend="numpy", capture_plane=None,
                         trial_dms=None, dm_block=None, chan_block=None,
-                        dtype=None, kernel="auto"):
+                        dtype=None, kernel="auto", snr_floor=None):
     """Sweep trial DMs over ``data`` and score each dedispersed series.
 
     Parameters mirror the reference façade
@@ -393,6 +583,10 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         (one trial per integer sample of band-crossing delay).
     dm_block, chan_block : JAX blocking factors (memory/speed trade-off).
     dtype : device dtype for the JAX path (default float32).
+    snr_floor : ``kernel="hybrid"`` only — when set, every row whose
+        coarse S/N reaches ``snr_floor - 0.75`` is exactly rescored too
+        (all above-threshold detections exact, not just the best); see
+        :func:`_search_jax_hybrid` for when this is affordable.
     kernel : JAX-path kernel selector: ``"auto"`` (Pallas on TPU, gather
         elsewhere), ``"pallas"`` (hand-written tiled TPU kernel, see
         :mod:`.pallas_dedisperse`), ``"gather"`` (portable XLA
@@ -400,7 +594,11 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         O(nchan log nchan) instead of O(ndm * nchan) — fastest for dense
         DM sweeps; uses its own integer band-delay trial grid and tree-
         rounded tracks, so hits agree with the exact kernels to within a
-        trial but not bit-identically; see :mod:`.fdmt`) or ``"fourier"``
+        trial but not bit-identically; see :mod:`.fdmt`), ``"hybrid"``
+        (FDMT coarse sweep + exact rescore of the hit region: exact hit
+        detection on the plan grid at near-FDMT throughput; adds an
+        ``exact`` bool column, see :func:`_search_jax_hybrid`) or
+        ``"fourier"``
         (Fourier-domain dedispersion: exact *fractional*-sample delays —
         the precision option for narrow pulses at high time resolution;
         O(ndm * nchan * T) with transcendentals, see :mod:`.fourier`).
@@ -448,6 +646,28 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
                                       bandwidth, sample_time)
     trial_dms = np.asarray(trial_dms, dtype=np.float64)
+
+    if kernel == "hybrid":
+        if backend != "jax":
+            raise ValueError("kernel='hybrid' requires backend='jax'")
+        import jax.numpy as _jnp
+
+        if dtype not in (None, _jnp.float32):
+            raise ValueError("kernel='hybrid' supports float32 only")
+        (maxvalues, stds, best_snrs, best_windows, best_peaks, exact,
+         plane) = _search_jax_hybrid(data, trial_dms, start_freq, bandwidth,
+                                     sample_time, capture_plane, dm_block,
+                                     chan_block, snr_floor=snr_floor)
+        table = ResultTable({
+            "DM": trial_dms,
+            "max": maxvalues,
+            "std": stds,
+            "snr": best_snrs,
+            "rebin": best_windows,
+            "peak": best_peaks,
+            "exact": exact,
+        })
+        return (table, plane) if (capture_plane or show) else table
 
     if backend == "numpy":
         (maxvalues, stds, best_snrs, best_windows, best_peaks,
