@@ -58,6 +58,32 @@ def test_jax_path_matches_numpy(rng):
     assert np.allclose(got, ref, atol=2e-3)
 
 
+def test_uniform_and_fallback_kernels_agree(rng):
+    # a non-uniform grid takes the exp-table fallback; the same DMs fed
+    # as a uniform grid take the incremental-rotation path — planes must
+    # agree to phase-quantisation accuracy
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.ops.fourier import _uniform_spacing
+
+    nchan, t = 8, 512
+    data = rng.normal(size=(nchan, t)).astype(np.float32)
+    dms = np.linspace(100, 200, 9)
+    assert _uniform_spacing(dms) is not None
+    jagged = dms.copy()
+    jagged[4] += 3.0  # break uniformity
+    assert _uniform_spacing(jagged) is None
+    uni = np.asarray(dedisperse_fourier(data, dms, *GEOM, xp=jnp,
+                                        dm_block=4))
+    ref = _dedisperse_fourier_numpy(np.asarray(data, np.float64),
+                                    fractional_delays(dms, nchan, *GEOM[:2]),
+                                    GEOM[2])
+    assert np.allclose(uni, ref, atol=2e-3)
+    fb = np.asarray(dedisperse_fourier(data, jagged, *GEOM, xp=jnp))
+    # rows before the break are common to both grids
+    assert np.allclose(fb[:4], uni[:4], atol=2e-3)
+
+
 def test_search_fourier_recovers_dm():
     from pulsarutils_tpu.models.simulate import simulate_test_data
     from pulsarutils_tpu.ops.search import dedispersion_search
