@@ -1,0 +1,46 @@
+# Sphinx configuration for the pulsarutils_tpu API docs.
+#
+# The capability-equivalent of the reference's sphinx/automodapi skeleton
+# (reference docs/index.rst + setup.cfg:45-50): API pages are generated
+# from the package docstrings with autodoc/autosummary; the hand-written
+# markdown guides under docs/ are pulled in via myst-parser.
+#
+# Build (CI does this; sphinx is not a runtime dependency):
+#   pip install sphinx myst-parser
+#   sphinx-build -b html docs/sphinx docs/_build/html
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(__file__, "..", "..", "..")))
+
+project = "pulsarutils_tpu"
+author = "pulsarutils_tpu developers"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "myst_parser",
+]
+
+autosummary_generate = True
+autodoc_member_order = "bysource"
+autodoc_default_options = {
+    "members": True,
+    "undoc-members": False,
+    "show-inheritance": True,
+}
+# jax/scipy are heavyweight and partly optional at doc-build time
+autodoc_mock_imports = ["matplotlib"]
+
+napoleon_numpy_docstring = True
+napoleon_google_docstring = False
+
+myst_enable_extensions = ["colon_fence"]
+
+templates_path = []
+exclude_patterns = ["_build"]
+html_theme = "alabaster"

@@ -1,0 +1,126 @@
+"""Generate a markdown API reference from the package docstrings.
+
+Stdlib-only (``inspect`` + ``importlib``) so the build works in
+environments without sphinx — the sphinx build (``docs/sphinx/``) is the
+CI path and produces richer HTML; this produces the in-repo
+``docs/api_generated.md`` so a *built* doc artifact always exists
+(capability-equivalent of the reference's automodapi skeleton,
+reference ``docs/index.rst``, ``setup.cfg:45-50``).
+
+Usage:  python tools/build_api_docs.py  [output_path]
+"""
+
+import importlib
+import inspect
+import os
+import sys
+
+MODULES = [
+    "pulsarutils_tpu.ops.plan",
+    "pulsarutils_tpu.ops.search",
+    "pulsarutils_tpu.ops.dedisperse",
+    "pulsarutils_tpu.ops.pallas_dedisperse",
+    "pulsarutils_tpu.ops.fdmt",
+    "pulsarutils_tpu.ops.fourier",
+    "pulsarutils_tpu.ops.clean_ops",
+    "pulsarutils_tpu.ops.robust",
+    "pulsarutils_tpu.ops.rebin",
+    "pulsarutils_tpu.ops.periodicity",
+    "pulsarutils_tpu.models.simulate",
+    "pulsarutils_tpu.pipeline.search_pipeline",
+    "pulsarutils_tpu.pipeline.spectral_stats",
+    "pulsarutils_tpu.pipeline.diagnostics",
+    "pulsarutils_tpu.pipeline.pulse_info",
+    "pulsarutils_tpu.pipeline.sift",
+    "pulsarutils_tpu.pipeline.cleanup",
+    "pulsarutils_tpu.parallel.mesh",
+    "pulsarutils_tpu.parallel.sharded",
+    "pulsarutils_tpu.parallel.stream",
+    "pulsarutils_tpu.parallel.multihost",
+    "pulsarutils_tpu.io.sigproc",
+    "pulsarutils_tpu.io.lowbit",
+    "pulsarutils_tpu.io.candidates",
+    "pulsarutils_tpu.utils.table",
+    "pulsarutils_tpu.utils.logging_utils",
+    "pulsarutils_tpu.cli.stats_main",
+    "pulsarutils_tpu.cli.search_main",
+    "pulsarutils_tpu.cli.clean_main",
+    "pulsarutils_tpu.cli.cands_main",
+]
+
+
+def _public_members(mod):
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n in vars(mod) if not n.startswith("_")]
+    for name in names:
+        obj = getattr(mod, name, None)
+        if obj is None or inspect.ismodule(obj):
+            continue
+        # only document what the module itself defines
+        if getattr(obj, "__module__", mod.__name__) != mod.__name__:
+            continue
+        if inspect.isfunction(obj) or inspect.isclass(obj):
+            yield name, obj
+
+
+def _signature(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _doc(obj, indent=""):
+    doc = inspect.getdoc(obj) or "*(undocumented)*"
+    return "\n".join(indent + line for line in doc.splitlines())
+
+
+def render(modules=MODULES):
+    out = ["# API reference (generated)",
+           "",
+           "Generated from docstrings by `tools/build_api_docs.py` — do "
+           "not edit by hand.  For HTML docs run the sphinx build "
+           "(`docs/sphinx/`).",
+           ""]
+    for modname in modules:
+        try:
+            mod = importlib.import_module(modname)
+        except Exception as exc:  # keep going: one bad import != no docs
+            out += [f"## `{modname}`", "", f"*import failed: {exc!r}*", ""]
+            continue
+        out += [f"## `{modname}`", ""]
+        if mod.__doc__:
+            out += [inspect.cleandoc(mod.__doc__), ""]
+        for name, obj in _public_members(mod):
+            kind = "class" if inspect.isclass(obj) else "def"
+            out += [f"### `{kind} {name}{_signature(obj)}`", "",
+                    _doc(obj), ""]
+            if inspect.isclass(obj):
+                for mname, meth in inspect.getmembers(obj):
+                    if mname.startswith("_") or not (
+                            inspect.isfunction(meth)
+                            or isinstance(meth, (classmethod, staticmethod))):
+                        continue
+                    if getattr(meth, "__qualname__", "").split(".")[0] != \
+                            obj.__name__:
+                        continue
+                    out += [f"- **`{mname}{_signature(meth)}`** — ",
+                            _doc(meth, indent="  "), ""]
+    return "\n".join(out) + "\n"
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    target = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "api_generated.md")
+    text = render()
+    with open(target, "w") as f:
+        f.write(text)
+    print(f"wrote {target} ({len(text.splitlines())} lines)")
+
+
+if __name__ == "__main__":
+    main()
