@@ -39,6 +39,8 @@ _DOUBLE_KEYS = {
     "fch1", "foff", "refdm", "period",
 }
 _STR_KEYS = {"source_name", "rawdatafile"}
+#: single-byte keys (sigproc's ``signed`` flag for 8-bit data)
+_CHAR_KEYS = {"signed"}
 
 _DTYPES = {8: np.uint8, 16: np.uint16, 32: np.float32}
 
@@ -56,6 +58,8 @@ def _pack_record(key, value):
         rec += struct.pack("<d", float(value))
     elif key in _STR_KEYS:
         rec += _pack_string(str(value))
+    elif key in _CHAR_KEYS:
+        rec += struct.pack("<b", int(value))
     else:
         raise KeyError(f"unknown SIGPROC header key {key!r}")
     return rec
@@ -83,7 +87,11 @@ def read_header(path):
                 (header[key],) = struct.unpack("<d", f.read(8))
             elif key in _STR_KEYS:
                 header[key] = read_string()
+            elif key in _CHAR_KEYS:
+                (header[key],) = struct.unpack("<b", f.read(1))
             else:
+                # unknown keys cannot be skipped (their payload length is
+                # key-specific), so fail loudly with the offending name
                 raise ValueError(f"{path}: unknown header key {key!r}")
         return header, f.tell()
 
@@ -148,6 +156,8 @@ class FilterbankReader:
                 shape=(self.header["nsamples"], nchans * nbits // 8))
         elif nbits in _DTYPES:
             self._dtype = _DTYPES[nbits]
+            if nbits == 8 and self.header.get("signed"):
+                self._dtype = np.int8  # sigproc ``signed`` char flag
             self._mmap = np.memmap(path, dtype=self._dtype, mode="r",
                                    offset=offset,
                                    shape=(self.header["nsamples"], nchans))
@@ -212,13 +222,15 @@ class FilterbankWriter:
             self._dtype = np.uint8
         elif self.nbits in _DTYPES:
             self._dtype = _DTYPES[self.nbits]
+            if self.nbits == 8 and self.header.get("signed"):
+                self._dtype = np.int8  # sigproc ``signed`` char flag
         else:
             raise ValueError(f"unsupported nbits={self.nbits}")
         self._file = open(path, "wb")
         self._nsamples_written = 0
         self._file.write(_pack_string("HEADER_START"))
         for key in sorted(set(self.header) & (_INT_KEYS | _DOUBLE_KEYS |
-                                              _STR_KEYS)):
+                                              _STR_KEYS | _CHAR_KEYS)):
             if key == "nsamples":
                 continue  # computed from data size on read
             self._file.write(_pack_record(key, self.header[key]))

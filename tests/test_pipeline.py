@@ -290,6 +290,51 @@ def test_search_by_chunks_period_search(pulsar_file, tmp_path):
     assert loaded.fold_profile is not None
 
 
+def test_period_search_end_to_end_realistic(tmp_path):
+    """End-to-end periodic-pulsar recovery at realistic size (VERDICT r1
+    #6): inject a known (f0, DM) pulsar into a file, stream it through
+    ``search_by_chunks(period_search=True)``, and require BOTH recovered
+    within tight tolerance — the pipeline-level analogue of the ops-level
+    tests in test_periodicity.py."""
+    from pulsarutils_tpu.models.simulate import simulate_pulsar_data
+
+    period, dm = 0.0625, 150.0  # f0 = 16 Hz
+    nchan, nsamples, tsamp = 128, 65536, 0.0005  # 32.8 s of data
+    array, header = simulate_pulsar_data(period=period, dm=dm,
+                                         nsamples=nsamples, nchan=nchan,
+                                         tsamp=tsamp, signal=0.6, noise=0.5,
+                                         duty_cycle=0.05, rng=42)
+    array = array + 20.0
+    sim_header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": tsamp,
+                  "foff": 200. / nchan}
+    path = str(tmp_path / "psr_big.fil")
+    write_simulated_filterbank(path, array, sim_header, descending=True)
+
+    # long chunks (several seconds, hundreds of pulse periods each) —
+    # the knob a real periodicity run would use
+    hits, store = search_by_chunks(
+        path, chunk_length=8192 * tsamp, dmmin=100, dmmax=200,
+        backend="jax", output_dir=str(tmp_path / "out"), make_plots=False,
+        snr_threshold=1e9,  # single-pulse path off: periodic-only hits
+        period_search=True, period_sigma_threshold=8.0, progress=False)
+    assert hits, "no periodic candidate recovered"
+    # take the most significant periodic hit across all chunks
+    best = max((h[2] for h in hits), key=lambda i: i.period_sigma or 0)
+    assert best.period_freq is not None
+    # frequency: the refined candidate must be a harmonic of f0 = 1/P
+    # to better than 0.5% of the harmonic number
+    ratio = best.period_freq * period
+    harmonic = round(ratio)
+    assert 1 <= harmonic <= 16
+    assert abs(ratio - harmonic) < 0.005 * max(harmonic, 1), (
+        best.period_freq, ratio)
+    # DM: within a few one-sample plan spacings (~0.65 DM units here)
+    assert abs(best.period_dm - dm) <= 3.0, best.period_dm
+    assert best.period_sigma > 8.0
+    assert best.fold_profile is not None and best.fold_profile.size >= 8
+
+
 def test_search_fallback_survives_device_failure(monkeypatch):
     """A device-side failure on a chunk degrades to the NumPy reference
     path instead of killing a long streaming search."""
