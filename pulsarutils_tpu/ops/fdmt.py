@@ -48,6 +48,7 @@ Implementation notes (TPU):
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -282,8 +283,9 @@ def _transform_setup(data, use_pallas):
 #: (tools/fdmt_tune.py): 32 @ tile 8192 = 0.352 s (1454 tr/s) vs 8 =
 #: 0.394 s; 64 @ 8192 exhausts scoped VMEM; tile size still dominates
 #: (8192 >> 4096 >> 2048).  Compile is slower at 32 (~25 s cold) but the
-#: persistent compilation cache amortises it.
-MERGE_ROW_BLOCK = 32
+#: persistent compilation cache amortises it.  Overridable via env
+#: ``PUTPU_MERGE_ROW_BLOCK`` (tuning/bisection without code edits).
+MERGE_ROW_BLOCK = int(os.environ.get("PUTPU_MERGE_ROW_BLOCK", 32))
 
 
 @functools.lru_cache(maxsize=64)
@@ -464,9 +466,19 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
             return plane
         from .search import score_profiles_stacked
 
-        # ONE (5, ndm) output array -> one host readback round trip over
-        # the tunnel (four separate vectors cost ~0.1 s latency each)
-        stacked = score_profiles_stacked(plane, xp=jnp)
+        # score in row chunks: whole-plane scoring materialises the
+        # mean-subtracted copy plus four boxcar block-sum arrays (~1.9x
+        # the plane) all at once, which HBM-OOMs the 4096-trial x 262k
+        # config on a 16 GB chip; a statically-unrolled chunk loop
+        # bounds the scorer's live temps to ~chunk/ndm of that.  Still
+        # ONE (5, ndm) output array -> one host readback round trip
+        # over the tunnel (four separate vectors cost ~0.1 s each).
+        rows = plane.shape[0]
+        chunk = 512
+        stacked = jnp.concatenate(
+            [score_profiles_stacked(plane[lo:min(lo + chunk, rows)],
+                                    xp=jnp)
+             for lo in range(0, rows, chunk)], axis=1)
         return (stacked, plane) if with_plane else stacked
 
     return jax.jit(fn)
