@@ -48,6 +48,12 @@ def build_parser():
                         help="subtract the channel-averaged time series "
                              "(broadband un-dispersed RFI filter)")
     parser.add_argument("--output-dir", default=None)
+    parser.add_argument("--show-plots", action="store_true",
+                        help="display each diagnostic figure interactively "
+                             "as well as saving it (reference show=True "
+                             "behaviour; needs an interactive matplotlib "
+                             "backend — on a headless Agg session the "
+                             "figures are only saved)")
     parser.add_argument("--plots", choices=("hits", "all", "none"),
                         default="hits")
     parser.add_argument("--no-resume", action="store_true",
@@ -100,6 +106,7 @@ def main(args=None):
             snr_threshold=opts.snr_threshold,
             output_dir=opts.output_dir,
             make_plots=False if opts.plots == "none" else opts.plots,
+            show_plots=opts.show_plots,
             resume=not opts.no_resume,
             fft_zap=opts.fft_zap,
             cut_outliers=opts.cut_outliers,

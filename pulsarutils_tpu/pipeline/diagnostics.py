@@ -51,9 +51,34 @@ def plot_diagnostics(info, table, plane, outname="info.jpg", t0=0.0,
         (from ``dedispersion_search(..., capture_plane=True)``) — NOT
         recomputed here.
     """
+    # build first: for the batch path it pins the Agg backend BEFORE the
+    # first pyplot import resolves a (possibly GUI) backend
+    fig, _axes = build_diagnostic_figure(info, table, plane, t0=t0,
+                                         interactive=show)
+    import matplotlib.pyplot as plt
+
+    fig.savefig(outname, bbox_inches="tight")
+    if show:
+        plt.show()
+    plt.close(fig)
+    return outname
+
+
+def build_diagnostic_figure(info, table, plane, t0=0.0, interactive=False):
+    """Build (but do not save) the 7-panel figure.
+
+    Returns ``(fig, axes)`` with ``axes`` a dict keyed ``raw, dedisp,
+    lc_raw, lc_dedisp, plane, snr, h`` — separated from
+    :func:`plot_diagnostics` so tests can assert each panel's artists
+    against the data that should back them.  ``interactive=False``
+    (the pipeline default) pins the Agg backend so batch runs never
+    touch a display; ``interactive=True`` leaves the user's backend
+    alone so a subsequent ``plt.show()`` can actually open a window.
+    """
     import matplotlib
 
-    matplotlib.use("Agg", force=False)
+    if not interactive:
+        matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt
 
     array = np.asarray(info.allprofs)
@@ -142,8 +167,6 @@ def plot_diagnostics(info, table, plane, outname="info.jpg", t0=0.0,
         ax_fold.set_xticks([]), ax_fold.set_yticks([])
         ax_fold.set_title("folded", fontsize=6, pad=1)
 
-    fig.savefig(outname, bbox_inches="tight")
-    if show:
-        plt.show()
-    plt.close(fig)
-    return outname
+    return fig, {"raw": ax_raw, "dedisp": ax_ded, "lc_raw": ax_lc_raw,
+                 "lc_dedisp": ax_lc_ded, "plane": ax_plane, "snr": ax_snr,
+                 "h": ax_h}

