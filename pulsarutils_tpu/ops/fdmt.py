@@ -427,10 +427,13 @@ def _merge_pallas(state, it, t_tile, interpret):
 
 
 @functools.lru_cache(maxsize=16)
-def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
-                     use_pallas, interpret, n_lo=0, with_scores=False,
-                     with_plane=True, t_orig=None):
-    """One jitted program: DM-pruned merges [+ scoring].
+def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
+                  use_pallas, interpret, n_lo=0, with_scores=False,
+                  with_plane=True, t_orig=None):
+    """The traceable (un-jitted) transform body: DM-pruned merges
+    [+ scoring].  :func:`_build_transform` wraps it in ``jax.jit``;
+    the hybrid search composes it with its fused seed-rescore program
+    (``ops/search.py:_fused_hybrid_seed_kernel``) instead.
 
     The plan is built with ``min_delay = n_lo`` (see :class:`FdmtPlan`),
     so rows below the searched DM range are never computed — the final
@@ -439,7 +442,6 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
     full state keeps gigabytes alive and OOMs back-to-back searches at
     the 1M-sample size.
     """
-    import jax
     import jax.numpy as jnp
 
     plan = fdmt_plan(nchan, start_freq, bandwidth, max_delay, n_lo)
@@ -481,7 +483,20 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
              for lo in range(0, rows, chunk)], axis=1)
         return (stacked, plane) if with_plane else stacked
 
-    return jax.jit(fn)
+    return fn
+
+
+@functools.lru_cache(maxsize=16)
+def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
+                     use_pallas, interpret, n_lo=0, with_scores=False,
+                     with_plane=True, t_orig=None):
+    """Jitted wrapper of :func:`_transform_fn` (same signature)."""
+    import jax
+
+    return jax.jit(_transform_fn(nchan, start_freq, bandwidth, max_delay,
+                                 t, t_tile, use_pallas, interpret,
+                                 n_lo=n_lo, with_scores=with_scores,
+                                 with_plane=with_plane, t_orig=t_orig))
 
 
 # ---------------------------------------------------------------------------

@@ -390,6 +390,74 @@ HYBRID_MAX_ROUNDS = 20
 HYBRID_COARSE_TRUST = 0.45
 
 
+#: top-k coarse rows the fused seed program rescores device-side (plus
+#: grid neighbours, padded to one HYBRID_RESCORE_BUCKETS[-1] bucket)
+HYBRID_SEED_TOPK = 10
+
+
+@functools.lru_cache(maxsize=8)
+def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
+                              t_tile, n_lo, t_orig, max_off, ndm_plan,
+                              bucket):
+    """ONE jitted program for the hybrid's first round on TPU:
+
+    FDMT coarse sweep -> plan-grid score mapping -> device-side top-k
+    seed selection (+/-1 grid neighbours) -> exact Pallas rescore of the
+    seed bucket -> everything packed into a single flat float32 array.
+
+    Collapses three tunnel round trips (coarse readback, seed offsets
+    upload [cached instead], rescore readback) into one dispatch + one
+    readback — each trip costs ~0.1 s on the tunnelled platform, the
+    difference between ~650 and ~850 DM-trials/s at the benchmark shape.
+    Packing layout: ``[coarse (5*ndm_plan) | sel (bucket) |
+    exact (5*bucket)]`` (indices < 2^24 are exact in float32).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .fdmt import _transform_fn
+    from .pallas_dedisperse import dedisperse_plane_pallas_traced
+
+    coarse_fn = _transform_fn(nchan, start_freq, bandwidth, n_hi, t_run,
+                              t_tile, True, False, n_lo=n_lo,
+                              with_scores=True, with_plane=False,
+                              t_orig=t_orig)
+    k = min(HYBRID_SEED_TOPK, ndm_plan)  # top_k requires k <= axis size
+
+    @jax.jit
+    def run(data, idx_map, offsets_rebased):
+        stacked_f = coarse_fn(data)               # (5, ndm_fdmt)
+        coarse = stacked_f[:, idx_map]            # (5, ndm_plan)
+        _, top = jax.lax.top_k(coarse[2], k)
+        sel = jnp.concatenate([top - 1, top, top + 1])
+        sel = jnp.clip(sel, 0, ndm_plan - 1)
+        sel = jnp.concatenate(
+            [sel, jnp.broadcast_to(sel[:1], (bucket - 3 * k,))])
+        offs = offsets_rebased[sel]               # (bucket, nchan) rows
+        plane = dedisperse_plane_pallas_traced(data, offs, max_off,
+                                               dm_block=bucket)
+        exact = score_profiles_stacked(plane, xp=jnp)   # (5, bucket)
+        return jnp.concatenate([coarse.reshape(-1),
+                                sel.astype(jnp.float32),
+                                exact.reshape(-1)])
+
+    return run
+
+
+@functools.lru_cache(maxsize=4)
+def _device_offsets_cache(offsets_bytes, shape):
+    """Device-resident rebased-offset table, cached across searches.
+
+    The 2 MB int32 table is deterministic in (geometry, trial grid,
+    nsamples); re-uploading it per search costs ~0.1 s over the tunnel.
+    Keyed by the host bytes — the lru holds the device buffer alive.
+    """
+    import jax.numpy as jnp
+
+    return jnp.asarray(
+        np.frombuffer(offsets_bytes, dtype=np.int32).reshape(shape))
+
+
 @functools.lru_cache(maxsize=16)
 def _fused_rescore_kernel(max_off, dm_block):
     """One jitted program: Pallas dedisperse (un-rebased output) + score.
@@ -466,35 +534,14 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
     diagnostics product and the tree rows agree with the exact series up
     to track rounding and a small circular rotation (:mod:`.fdmt`).
     """
+    import jax
+
+    from .fdmt import _pick_fdmt_tile, fdmt_trial_dms
+
     ndm = len(trial_dms)
     nchan, nsamples = np.shape(data)
     dmmin = float(np.min(trial_dms))
     dmmax = float(np.max(trial_dms))
-
-    # 1. coarse sweep (scores for every trial in log-depth passes)
-    (fdmt_dms, c_max, c_std, c_snr, c_win, c_peak, plane) = _search_jax_fdmt(
-        data, dmmin, dmmax, start_freq, bandwidth, sample_time, capture_plane)
-    # nearest coarse row for each plan row (both grids are sorted,
-    # one-sample spacing, offset by < 1 trial)
-    pos = np.searchsorted(fdmt_dms, trial_dms)
-    lo = np.clip(pos - 1, 0, len(fdmt_dms) - 1)
-    hi = np.clip(pos, 0, len(fdmt_dms) - 1)
-    idx = np.where(np.abs(fdmt_dms[lo] - trial_dms)
-                   <= np.abs(fdmt_dms[hi] - trial_dms), lo, hi)
-    if plane is not None and plane.shape[0] != ndm:
-        # align the coarse plane with the plan grid (row gather — cheap,
-        # and row-major on TPU unlike the scalarising lane gather)
-        plane = plane[idx]
-
-    maxvalues = np.asarray(c_max, np.float64)[idx]
-    stds = np.asarray(c_std, np.float64)[idx]
-    snrs = np.asarray(c_snr, np.float64)[idx]
-    windows = np.asarray(c_win, np.int32)[idx]
-    peaks = np.asarray(c_peak, np.int64)[idx]
-    coarse_snrs = snrs.copy()
-    exact = np.zeros(ndm, dtype=bool)
-
-    import jax
 
     use_fused = jax.default_backend() == "tpu"
     if use_fused:
@@ -510,6 +557,66 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         rebased_full, roll_k, max_off = rebase_offsets(offsets_full,
                                                        nsamples)
         data32 = jnp.asarray(data, jnp.float32)
+
+    # nearest coarse (integer band-delay) row for each plan row — both
+    # grids are sorted with one-sample spacing, offset by < 1 trial;
+    # host-computable before any device work
+    fdmt_dms, n_lo, n_hi = fdmt_trial_dms(nchan, dmmin, dmmax, start_freq,
+                                          bandwidth, sample_time)
+    pos = np.searchsorted(fdmt_dms, trial_dms)
+    lo = np.clip(pos - 1, 0, len(fdmt_dms) - 1)
+    hi = np.clip(pos, 0, len(fdmt_dms) - 1)
+    idx = np.where(np.abs(fdmt_dms[lo] - trial_dms)
+                   <= np.abs(fdmt_dms[hi] - trial_dms), lo, hi)
+
+    plane = None
+    # the fused program earns its keep on wide sweeps; narrow grids
+    # (fewer trials than the seed bucket) take the two-stage path, which
+    # also avoids top_k k > ndm edge cases
+    fused_seed = (use_fused and not capture_plane
+                  and ndm >= 3 * HYBRID_SEED_TOPK
+                  and _pick_fdmt_tile(nsamples) > 0)
+    if fused_seed:
+        # 1+2 fused: coarse sweep, device-side top-k seed selection and
+        # exact seed rescore in ONE dispatch + ONE packed readback (each
+        # tunnel round trip costs ~0.1 s).  Requires the unpadded time
+        # axis (a pad would shift the rescore's circular wrap off the
+        # exact kernels' convention).
+        bucket = HYBRID_RESCORE_BUCKETS[-1]
+        assert bucket >= 3 * HYBRID_SEED_TOPK
+        t_tile = _pick_fdmt_tile(nsamples)
+        kernel = _fused_hybrid_seed_kernel(
+            nchan, float(start_freq), float(bandwidth), n_hi, nsamples,
+            t_tile, n_lo, None, max_off, ndm, bucket)
+        offs_dev = _device_offsets_cache(rebased_full.tobytes(),
+                                         rebased_full.shape)
+        packed = np.asarray(kernel(data32, jnp.asarray(idx.astype(np.int32)),
+                                   offs_dev))
+        coarse = packed[:5 * ndm].reshape(5, ndm).astype(np.float64)
+        sel = np.rint(packed[5 * ndm:5 * ndm + bucket]).astype(np.int64)
+        seed_scores = packed[5 * ndm + bucket:].reshape(5, bucket)
+        maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]
+        windows = np.rint(coarse[3]).astype(np.int32)
+        peaks = np.rint(coarse[4]).astype(np.int64)
+    else:
+        # two-stage path (CPU, plane capture, or awkward time axes):
+        # coarse sweep first, scores mapped host-side
+        (_, c_max, c_std, c_snr, c_win, c_peak, plane) = _search_jax_fdmt(
+            data, dmmin, dmmax, start_freq, bandwidth, sample_time,
+            capture_plane)
+        if plane is not None and plane.shape[0] != ndm:
+            # align the coarse plane with the plan grid (row gather —
+            # cheap, and row-major on TPU unlike the scalarising lane
+            # gather)
+            plane = plane[idx]
+        maxvalues = np.asarray(c_max, np.float64)[idx]
+        stds = np.asarray(c_std, np.float64)[idx]
+        snrs = np.asarray(c_snr, np.float64)[idx]
+        windows = np.asarray(c_win, np.int32)[idx]
+        peaks = np.asarray(c_peak, np.int64)[idx]
+
+    coarse_snrs = snrs.copy()
+    exact = np.zeros(ndm, dtype=bool)
 
     def _apply(blk, scored):
         m, s, b, w, p = scored
@@ -548,13 +655,33 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
 
     # 2. seed: plausible-best rows (plus opt-in threshold hits), plus
     # grid neighbours (the coarse grid sits up to one trial off the plan)
-    seed = (coarse_snrs >= coarse_snrs.max() - 0.5)
-    if snr_floor is not None:
-        seed |= coarse_snrs >= snr_floor - 0.75
-    seed_idx = np.flatnonzero(seed)
-    grown = np.unique(np.clip(seed_idx[:, None]
-                              + np.arange(-1, 2)[None, :], 0, ndm - 1))
-    rescore(grown)
+    if fused_seed:
+        # the device already rescored the top-k neighbourhood: unpack it
+        m, s, b_, w, p = (seed_scores[i].astype(np.float64)
+                          for i in range(5))
+        w = np.rint(w).astype(np.int32)
+        p = (np.rint(p).astype(np.int64) - roll_k) % nsamples
+        _apply(sel, (m, s, b_, w, p))
+        if snr_floor is not None:
+            # same +/-1 neighbour growth as the two-stage seed, so the
+            # "all above-threshold detections exact" contract is
+            # platform-independent
+            extra = np.flatnonzero(coarse_snrs >= snr_floor - 0.75)
+            if extra.size:
+                near = np.unique(np.clip(
+                    extra[:, None] + np.arange(-1, 2)[None, :], 0,
+                    ndm - 1))
+                todo = near[~exact[near]]
+                if todo.size:
+                    rescore(todo)
+    else:
+        seed = (coarse_snrs >= coarse_snrs.max() - 0.5)
+        if snr_floor is not None:
+            seed |= coarse_snrs >= snr_floor - 0.75
+        seed_idx = np.flatnonzero(seed)
+        grown = np.unique(np.clip(seed_idx[:, None]
+                                  + np.arange(-1, 2)[None, :], 0, ndm - 1))
+        rescore(grown)
 
     # 3. guarantee loop.  An unrescored row j can only beat the exact
     # best if its coarse score understated it (exact_j <= coarse_j + U,

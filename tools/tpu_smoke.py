@@ -71,6 +71,33 @@ def _search_parity():
     assert t_pl.argbest() == t_np.argbest(), (t_pl.argbest(), t_np.argbest())
 
 
+@check("hybrid: fused seed path hits byte-equal to NumPy reference")
+def _hybrid():
+    import numpy as np
+
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    # 8192 samples: power-of-two time axis -> the fused single-dispatch
+    # seed program (coarse + device top-k + exact rescore) runs for real
+    array, header = simulate_test_data(150, nchan=64, nsamples=8192,
+                                       signal=2.0, noise=0.4, rng=21)
+    args = (100, 200.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+    t_np = dedispersion_search(array, *args, backend="numpy")
+    t_h = dedispersion_search(array, *args, backend="jax", kernel="hybrid")
+    best = t_np.argbest()
+    assert t_h.argbest() == best, (t_h.argbest(), best)
+    assert bool(t_h["exact"][best])
+    assert int(t_h["rebin"][best]) == int(t_np["rebin"][best])
+    assert int(t_h["peak"][best]) == int(t_np["peak"][best])
+    # non-pow2 length exercises the two-stage fallback on TPU too
+    t_h2 = dedispersion_search(array[:, :7000], *args, backend="jax",
+                               kernel="hybrid")
+    t_np2 = dedispersion_search(array[:, :7000], *args, backend="numpy")
+    assert t_h2.argbest() == t_np2.argbest()
+
+
 @check("fourier kernel: DM recovered, agrees with numpy FDD")
 def _fourier():
     import numpy as np
