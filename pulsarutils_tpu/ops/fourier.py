@@ -122,7 +122,6 @@ def _jitted_fourier(t, dm_block, chan_block, with_scores, with_plane=True):
     def run(data, limbs):
         from .search import score_profiles_stacked
 
-        spec = jnp.fft.rfft(data, axis=1)
         nbin = t // 2 + 1
         k = jnp.arange(nbin, dtype=jnp.int32)[None, None, :]
         kf = k.astype(jnp.float32)
@@ -130,7 +129,8 @@ def _jitted_fourier(t, dm_block, chan_block, with_scores, with_plane=True):
         ndm = limbs.shape[1]
         nc = -(-nchan // chan_block)
         nd = -(-ndm // dm_block)
-        spec = jnp.pad(spec, ((0, nc * chan_block - nchan), (0, 0)))
+        data_p = jnp.pad(data, ((0, nc * chan_block - nchan), (0, 0)))
+        spec = _blocked_rfft(data_p, chan_block, nbin)
         limbs_p = jnp.pad(limbs, ((0, 0), (0, nd * dm_block - ndm),
                                   (0, nc * chan_block - nchan)))
 
@@ -174,6 +174,32 @@ def _jitted_fourier(t, dm_block, chan_block, with_scores, with_plane=True):
         return (scores, plane) if with_plane else scores
 
     return run
+
+
+def _blocked_rfft(data, chan_block, nbin):
+    """rFFT of ``data`` row-blocks via ``fori_loop``.
+
+    XLA's TPU FFT lowering materialises convolution temps proportional
+    to the *batch* size — a single rfft over (1024, 1M) data wants
+    ~20 GB of HLO temps and fails to compile on a 16 GB chip.  Rows are
+    independent, so filling the spectrum ``chan_block`` rows at a time
+    is bit-identical and caps the temps at ``chan_block/nchan`` of that.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nchan_p, t = data.shape
+    nc = nchan_p // chan_block
+
+    def fill(j, spec):
+        sp = jnp.fft.rfft(
+            jax.lax.dynamic_slice_in_dim(data, j * chan_block, chan_block,
+                                         axis=0), axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(spec, sp, j * chan_block,
+                                                   axis=0)
+
+    return jax.lax.fori_loop(
+        0, nc, fill, jnp.zeros((nchan_p, nbin), jnp.complex64))
 
 
 def _uniform_spacing(trial_dms):
@@ -243,11 +269,11 @@ def _jitted_fourier_uniform(t, superblock, chan_block, with_scores,
     def run(data, anchor_limbs, step_limbs):
         from .search import score_profiles_stacked
 
-        spec = jnp.fft.rfft(data, axis=1)  # (nchan, nbin) complex64
         nchan = data.shape[0]
         nblocks = anchor_limbs.shape[1]
         nc = -(-nchan // chan_block)
-        spec = jnp.pad(spec, ((0, nc * chan_block - nchan), (0, 0)))
+        data_p = jnp.pad(data, ((0, nc * chan_block - nchan), (0, 0)))
+        spec = _blocked_rfft(data_p, chan_block, nbin)
         anchor_p = jnp.pad(anchor_limbs,
                            ((0, 0), (0, 0), (0, nc * chan_block - nchan)))
         step_p = jnp.pad(step_limbs, ((0, 0), (0, nc * chan_block - nchan)))

@@ -128,6 +128,32 @@ def test_hybrid_plane_capture(sim):
     assert np.asarray(plane[best]).max() >= 0.5 * plane_np[best].max()
 
 
+@pytest.mark.parametrize("nchan,start_freq,bandwidth,dmmin,dmmax", [
+    (32, 1200.0, 200.0, 50.0, 250.0),
+    (64, 400.0, 100.0, 20.0, 120.0),    # low-frequency band, steep delays
+    (48, 1500.0, 300.0, 100.0, 400.0),  # non-power-of-two channels
+    (128, 800.0, 50.0, 10.0, 60.0),     # narrow band
+])
+def test_hybrid_exact_hits_across_geometries(nchan, start_freq, bandwidth,
+                                             dmmin, dmmax):
+    """The hybrid's guarantee loop must land on the exact argbest for
+    varied band geometries (the margin logic is geometry-independent)."""
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+
+    tsamp = 0.0005
+    dm = 0.5 * (dmmin + dmmax)
+    array, header = simulate_test_data(
+        dm, tsamp=tsamp, nchan=nchan, nsamples=4096, start_freq=start_freq,
+        bandwidth=bandwidth, signal=3.0, noise=0.3, rng=int(nchan) + 1)
+    args = (dmmin, dmmax, header["fbottom"], header["bandwidth"], tsamp)
+    t_np = dedispersion_search(array, *args, backend="numpy")
+    t_h = dedispersion_search(array, *args, backend="jax", kernel="hybrid")
+    best = t_np.argbest("snr")
+    assert t_h.argbest("snr") == best
+    assert bool(t_h["exact"][best])
+    assert t_h["rebin"][best] == t_np["rebin"][best]
+
+
 def test_jax_blocking_invariance(sim):
     # dm_block / chan_block are pure performance knobs — results identical
     t_a = _search(sim, backend="jax", dm_block=8, chan_block=16)
