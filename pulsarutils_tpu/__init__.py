@@ -109,6 +109,8 @@ def __getattr__(name):
         "CandidateStore": ("io.candidates", "CandidateStore"),
         "sharded_dedispersion_search": ("parallel.sharded",
                                         "sharded_dedispersion_search"),
+        "sharded_fdmt_search": ("parallel.sharded_fdmt",
+                                "sharded_fdmt_search"),
         "ring_dedisperse": ("parallel.stream", "ring_dedisperse"),
         "make_mesh": ("parallel.mesh", "make_mesh"),
         "fdmt_transform": ("ops.fdmt", "fdmt_transform"),

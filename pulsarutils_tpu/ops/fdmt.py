@@ -394,6 +394,25 @@ def _build_merge_kernel(rows_out, rows_in, t, t_tile, k_tiles, k_tiles_h,
     return run
 
 
+def merge_rows_traced(state, idx_low, idx_high, shift, shift_high, *,
+                      k_tiles, k_tiles_h, t_tile, interpret):
+    """One Pallas merge pass with *traced* (runtime) tables.
+
+    The tables arrive as jax arrays — they ride the scalar-prefetch
+    operands, so the same compiled program serves different merge
+    schedules of identical shape (the sharded FDMT ships each device its
+    own tables through ``shard_map``).  ``k_tiles``/``k_tiles_h`` must be
+    static bounds covering every shift value; row count must already be
+    a multiple of :data:`MERGE_ROW_BLOCK` (or smaller than it).
+    """
+    rows_in, t = state.shape
+    rows_out = idx_low.shape[0]
+    row_block = min(MERGE_ROW_BLOCK, rows_out)
+    run = _build_merge_kernel(rows_out, rows_in, t, t_tile, k_tiles,
+                              k_tiles_h, row_block, interpret)
+    return run(state, idx_low, idx_high, shift, shift_high)
+
+
 def _merge_pallas(state, it, t_tile, interpret):
     import jax.numpy as jnp
 
@@ -419,10 +438,11 @@ def _merge_pallas(state, it, t_tile, interpret):
     else:
         k_tiles_h = 0
         shift_high = np.zeros(rows_out + pad, np.int32)
-    run = _build_merge_kernel(rows_out + pad, rows_in, t, t_tile, k_tiles,
-                              k_tiles_h, row_block, interpret)
-    out = run(state, jnp.asarray(idx_low), jnp.asarray(idx_high),
-              jnp.asarray(shift), jnp.asarray(shift_high))
+    out = merge_rows_traced(state, jnp.asarray(idx_low),
+                            jnp.asarray(idx_high), jnp.asarray(shift),
+                            jnp.asarray(shift_high), k_tiles=k_tiles,
+                            k_tiles_h=k_tiles_h, t_tile=t_tile,
+                            interpret=interpret)
     return out[:rows_out] if pad else out
 
 
@@ -466,21 +486,12 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
             plane = plane[:, :t_orig]
         if not with_scores:
             return plane
-        from .search import score_profiles_stacked
+        from .search import score_profiles_chunked
 
-        # score in row chunks: whole-plane scoring materialises the
-        # mean-subtracted copy plus four boxcar block-sum arrays (~1.9x
-        # the plane) all at once, which HBM-OOMs the 4096-trial x 262k
-        # config on a 16 GB chip; a statically-unrolled chunk loop
-        # bounds the scorer's live temps to ~chunk/ndm of that.  Still
-        # ONE (5, ndm) output array -> one host readback round trip
-        # over the tunnel (four separate vectors cost ~0.1 s each).
-        rows = plane.shape[0]
-        chunk = 512
-        stacked = jnp.concatenate(
-            [score_profiles_stacked(plane[lo:min(lo + chunk, rows)],
-                                    xp=jnp)
-             for lo in range(0, rows, chunk)], axis=1)
+        # row-chunked scoring bounds the scorer's HBM temps (see
+        # score_profiles_chunked) while still emitting ONE (5, ndm)
+        # array -> one host readback round trip over the tunnel
+        stacked = score_profiles_chunked(plane, jnp)
         return (stacked, plane) if with_plane else stacked
 
     return fn

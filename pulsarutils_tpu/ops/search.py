@@ -95,6 +95,22 @@ def score_profiles_stacked(plane, xp=np):
     return xp.stack([s.astype(dtype) for s in scores])
 
 
+def score_profiles_chunked(plane, xp, chunk=512):
+    """:func:`score_profiles_stacked` over row chunks of a large plane.
+
+    Whole-plane scoring materialises the mean-subtracted copy plus four
+    boxcar block-sum arrays (~1.9x the plane) all at once — an HBM OOM
+    at multi-thousand-trial x long-T shapes on a 16 GB chip.  The
+    statically-unrolled chunk loop bounds the scorer's live temps to
+    ~``chunk/ndm`` of that, still emitting ONE ``(5, ndm)`` array (one
+    host readback round trip).
+    """
+    rows = plane.shape[0]
+    return xp.concatenate(
+        [score_profiles_stacked(plane[lo:min(lo + chunk, rows)], xp=xp)
+         for lo in range(0, rows, chunk)], axis=1)
+
+
 def unstack_scores(stacked):
     """Host-side inverse of :func:`score_profiles_stacked` (one readback)."""
     stacked = np.asarray(stacked)

@@ -35,6 +35,7 @@ MODULES = [
     "pulsarutils_tpu.pipeline.cleanup",
     "pulsarutils_tpu.parallel.mesh",
     "pulsarutils_tpu.parallel.sharded",
+    "pulsarutils_tpu.parallel.sharded_fdmt",
     "pulsarutils_tpu.parallel.stream",
     "pulsarutils_tpu.parallel.multihost",
     "pulsarutils_tpu.io.sigproc",
