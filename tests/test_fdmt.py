@@ -172,6 +172,28 @@ class TestSearch:
             make_plots=False, output_dir=str(tmp_path))
         assert any(abs(info.dm - 150) < 5 for _, _, info, _ in hits)
 
+    def test_pipeline_accepts_hybrid_kernel(self, tmp_path):
+        # the streaming driver must run the hybrid end-to-end (exact
+        # hits at coarse-sweep cost) just like any other kernel
+        from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+        from pulsarutils_tpu.models.simulate import disperse_array
+        from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+        rng = np.random.default_rng(14)
+        nchan, nsamples = 32, 8192
+        array = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+        array[:, 5000] += 4.0
+        array = disperse_array(array, 150, 1200., 200., 0.0005)
+        header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": 0.0005,
+                  "foff": 200. / nchan}
+        fname = str(tmp_path / "h.fil")
+        write_simulated_filterbank(fname, array, header, descending=True)
+        hits, store = search_by_chunks(
+            fname, dmmin=100, dmmax=200, backend="jax", kernel="hybrid",
+            make_plots=False, output_dir=str(tmp_path))
+        assert any(abs(info.dm - 150) < 5 for _, _, info, _ in hits)
+
     def test_fdmt_requires_jax_backend(self):
         array, header = simulate_test_data(150, nchan=16, nsamples=512,
                                            rng=9)
