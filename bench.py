@@ -102,8 +102,14 @@ def upload(array):
     return device_array
 
 
-def measure_kernel(device_array, kernel):
-    """Warm + time one steady-state full sweep; -> (table, trials/s, secs)."""
+def measure_kernel(device_array, kernel, repeats=2):
+    """Warm + time steady-state sweeps (best of ``repeats``).
+
+    Steady-state times vary ±15% run-to-run on the tunnelled platform
+    (shared worker, host jitter); min-of-2 is the honest steady-state
+    estimator — both raw times are logged.
+    Returns ``(table, trials/s, secs)``.
+    """
     from pulsarutils_tpu.ops.search import dedispersion_search
     from pulsarutils_tpu.utils.logging_utils import device_trace
 
@@ -117,13 +123,20 @@ def measure_kernel(device_array, kernel):
     log(f"first run (incl. compile): {time.time() - t0:.2f}s")
 
     trace_dir = os.environ.get("BENCH_TRACE")
+    times = []
     with device_trace(trace_dir):  # no-op when BENCH_TRACE unset
         t0 = time.time()
         table = run()
-        dt = time.time() - t0
+        times.append(time.time() - t0)
     if trace_dir:
         log(f"profiler trace written to {trace_dir}")
-    log(f"kernel={kernel}: {dt:.3f}s steady-state, {table.nrows} trials "
+    for _ in range(repeats - 1):  # outside the trace: one sweep per capture
+        t0 = time.time()
+        table = run()
+        times.append(time.time() - t0)
+    dt = min(times)
+    log(f"kernel={kernel}: {dt:.3f}s steady-state "
+        f"(best of {[round(x, 3) for x in times]}), {table.nrows} trials "
         f"-> {table.nrows / dt:.1f} DM-trials/s")
     return table, table.nrows / dt, dt
 

@@ -242,8 +242,24 @@ def _merge_xla(state, idx_low, idx_high, shift, shift_high=None):
 
 
 def _pick_fdmt_tile(t):
-    """Largest power-of-two tile in [1024, 8192] dividing ``t`` (0 if none)."""
-    for t_tile in (8192, 4096, 2048, 1024):
+    """Largest power-of-two tile in [1024, 8192] dividing ``t`` (0 if none).
+
+    Env ``PUTPU_FDMT_TILE`` caps/overrides the preference (tuning knob:
+    the kernel accepts any power-of-two tile dividing ``t``, but VMEM
+    limits the (tile x MERGE_ROW_BLOCK) product).
+    """
+    prefs = (8192, 4096, 2048, 1024)
+    try:
+        override = int(os.environ.get("PUTPU_FDMT_TILE") or 0)
+    except ValueError:
+        override = 0
+    # only a power-of-two >= 1024 is a legal tile; anything else would
+    # break the pad-guarantees-a-tile invariant of _transform_setup, so
+    # invalid overrides fall back to the defaults (which stay in prefs
+    # unconditionally for the same reason)
+    if override >= 1024 and (override & (override - 1)) == 0:
+        prefs = (override,) + prefs
+    for t_tile in prefs:
         if t % t_tile == 0:
             return t_tile
     return 0

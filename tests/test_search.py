@@ -154,6 +154,24 @@ def test_hybrid_exact_hits_across_geometries(nchan, start_freq, bandwidth,
     assert t_h["rebin"][best] == t_np["rebin"][best]
 
 
+def test_hybrid_explicit_trial_grid(sim):
+    # an explicit (non-plan) grid: coarse mapping collapses several plan
+    # rows onto one integer-delay row, the rescore uses the exact given
+    # DMs — argbest must still match numpy on the same grid
+    dms = np.linspace(130, 170, 97)  # denser than the integer-delay grid
+    array, header = sim
+    args = (array, 100, 200., header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+    t_np = dedispersion_search(*args, backend="numpy", trial_dms=dms)
+    t_h = dedispersion_search(*args, backend="jax", kernel="hybrid",
+                              trial_dms=dms)
+    assert t_h.nrows == 97
+    best = t_np.argbest("snr")
+    assert t_h.argbest("snr") == best
+    assert bool(t_h["exact"][best])
+    assert t_h["rebin"][best] == t_np["rebin"][best]
+
+
 def test_jax_blocking_invariance(sim):
     # dm_block / chan_block are pure performance knobs — results identical
     t_a = _search(sim, backend="jax", dm_block=8, chan_block=16)
