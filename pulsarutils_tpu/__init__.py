@@ -111,6 +111,8 @@ def __getattr__(name):
                                         "sharded_dedispersion_search"),
         "sharded_fdmt_search": ("parallel.sharded_fdmt",
                                 "sharded_fdmt_search"),
+        "sharded_hybrid_search": ("parallel.sharded_fdmt",
+                                  "sharded_hybrid_search"),
         "ring_dedisperse": ("parallel.stream", "ring_dedisperse"),
         "make_mesh": ("parallel.mesh", "make_mesh"),
         "fdmt_transform": ("ops.fdmt", "fdmt_transform"),

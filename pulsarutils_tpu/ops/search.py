@@ -406,6 +406,79 @@ HYBRID_MAX_ROUNDS = 20
 HYBRID_COARSE_TRUST = 0.45
 
 
+def iter_rescore_buckets(rows):
+    """Yield ``(rows_block, padded_block)`` per fixed-shape bucket.
+
+    Splits a rescore request into :data:`HYBRID_RESCORE_BUCKETS`-sized
+    blocks, each padded (repeating the last row) up to the next bucket —
+    a small set of static shapes keeps compiles bounded while not paying
+    the biggest block's cost for a handful of rows.  Shared by the
+    single-device and sharded hybrids.
+    """
+    rows = np.asarray(rows)
+    top = HYBRID_RESCORE_BUCKETS[-1]
+    for blk_lo in range(0, len(rows), top):
+        blk = rows[blk_lo:blk_lo + top]
+        bucket = next(b for b in HYBRID_RESCORE_BUCKETS if b >= len(blk))
+        yield blk, np.concatenate(
+            [blk, blk[-1:].repeat(bucket - len(blk))])
+
+
+def nearest_rows(sorted_grid, targets):
+    """Index of the nearest ``sorted_grid`` entry for each target value.
+
+    Maps plan-grid trial DMs onto the coarse integer-band-delay grid
+    (both sorted, one-sample spacing, offset < 1 trial apart) — shared
+    by the single-device and sharded hybrid searches.
+    """
+    sorted_grid = np.asarray(sorted_grid)
+    targets = np.asarray(targets)
+    pos = np.searchsorted(sorted_grid, targets)
+    lo = np.clip(pos - 1, 0, len(sorted_grid) - 1)
+    hi = np.clip(pos, 0, len(sorted_grid) - 1)
+    return np.where(np.abs(sorted_grid[lo] - targets)
+                    <= np.abs(sorted_grid[hi] - targets), lo, hi)
+
+
+def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
+                          snr_floor=None, seed_done=False):
+    """The hybrid's seed + guarantee iteration (see
+    :func:`_search_jax_hybrid` for the full rationale).
+
+    ``snrs``/``exact`` are mutated in place by ``rescore(rows)``; the
+    loop terminates when no unrescored row's coarse estimate reaches
+    ``best_exact - margin``, with margin the wider of 1.5x the worst
+    *observed* coarse underestimate and the structural
+    :data:`HYBRID_COARSE_TRUST` bound.  ``seed_done=True`` skips the
+    seeding round (the fused TPU program already rescored it).
+    """
+    ndm = len(coarse_snrs)
+    if not seed_done:
+        seed = (coarse_snrs >= coarse_snrs.max() - 0.5)
+        if snr_floor is not None:
+            seed |= coarse_snrs >= snr_floor - 0.75
+        seed_idx = np.flatnonzero(seed)
+        grown = np.unique(np.clip(seed_idx[:, None]
+                                  + np.arange(-1, 2)[None, :], 0, ndm - 1))
+        rescore(grown)
+    for _round in range(HYBRID_MAX_ROUNDS):
+        under = (snrs[exact] - coarse_snrs[exact]).max(initial=0.0)
+        best_exact = snrs[exact].max()
+        margin = max(1.5 * under, HYBRID_COARSE_TRUST * best_exact, 0.25)
+        need = (~exact) & (coarse_snrs >= best_exact - margin)
+        if snr_floor is not None:
+            need |= (~exact) & (coarse_snrs >= snr_floor - 0.75)
+        todo = np.flatnonzero(need)
+        if todo.size == 0:
+            break
+        rescore(todo)
+    else:
+        todo = np.flatnonzero(
+            (~exact) & (coarse_snrs >= snrs[exact].max() - 0.25))
+        if todo.size:
+            rescore(todo)
+
+
 #: top-k coarse rows the fused seed program rescores device-side (plus
 #: grid neighbours, padded to one HYBRID_RESCORE_BUCKETS[-1] bucket)
 HYBRID_SEED_TOPK = 10
@@ -574,16 +647,11 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
                                                        nsamples)
         data32 = jnp.asarray(data, jnp.float32)
 
-    # nearest coarse (integer band-delay) row for each plan row — both
-    # grids are sorted with one-sample spacing, offset by < 1 trial;
+    # nearest coarse (integer band-delay) row for each plan row —
     # host-computable before any device work
     fdmt_dms, n_lo, n_hi = fdmt_trial_dms(nchan, dmmin, dmmax, start_freq,
                                           bandwidth, sample_time)
-    pos = np.searchsorted(fdmt_dms, trial_dms)
-    lo = np.clip(pos - 1, 0, len(fdmt_dms) - 1)
-    hi = np.clip(pos, 0, len(fdmt_dms) - 1)
-    idx = np.where(np.abs(fdmt_dms[lo] - trial_dms)
-                   <= np.abs(fdmt_dms[hi] - trial_dms), lo, hi)
+    idx = nearest_rows(fdmt_dms, trial_dms)
 
     plane = None
     # the fused program earns its keep on wide sweeps; narrow grids
@@ -648,14 +716,7 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         """Exact scores for ``rows`` — fused Pallas+score program on TPU
         (one dispatch + one readback per bucketed call), the portable
         gather kernel elsewhere."""
-        rows = np.asarray(rows)
-        top = HYBRID_RESCORE_BUCKETS[-1]
-        for blk_lo in range(0, len(rows), top):
-            blk = rows[blk_lo:blk_lo + top]
-            bucket = next(b for b in HYBRID_RESCORE_BUCKETS
-                          if b >= len(blk))
-            padded = np.concatenate(
-                [blk, blk[-1:].repeat(bucket - len(blk))])
+        for blk, padded in iter_rescore_buckets(rows):
             if use_fused:
                 run = _fused_rescore_kernel(max_off, bucket)
                 stacked = run(data32, jnp.asarray(rebased_full[padded]))
@@ -669,8 +730,19 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
                     chan_block=chan_block, dtype=None, kernel="auto")
                 _apply(blk, (m, s, b_, w, p))
 
-    # 2. seed: plausible-best rows (plus opt-in threshold hits), plus
-    # grid neighbours (the coarse grid sits up to one trial off the plan)
+    # 2. seed (plausible-best rows + grid neighbours; the coarse grid
+    # sits up to one trial off the plan) and 3. guarantee loop — shared
+    # with the sharded hybrid (see hybrid_guarantee_loop).  An
+    # unrescored row j can only beat the exact best if its coarse score
+    # understated it (exact_j <= coarse_j + U, U the true max
+    # underestimate), so the margin is one-sided: the overestimate side
+    # (coarse > exact, typical of wing rows whose nearest coarse
+    # neighbour is the peak) must NOT widen it.  U is estimated two
+    # ways and the wider wins: adaptively (1.5x the worst underestimate
+    # observed on rescored rows — a biased, peak-clustered sample) and
+    # structurally (the HYBRID_COARSE_TRUST bound: tree track rounding
+    # deviates <= ~2 samples/channel, Zackay & Ofek 2017 sec 2.3,
+    # costing a boxcar-scored pulse at most ~1/sqrt(3) of its S/N).
     if fused_seed:
         # the device already rescored the top-k neighbourhood: unpack it
         m, s, b_, w, p = (seed_scores[i].astype(np.float64)
@@ -690,45 +762,8 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
                 todo = near[~exact[near]]
                 if todo.size:
                     rescore(todo)
-    else:
-        seed = (coarse_snrs >= coarse_snrs.max() - 0.5)
-        if snr_floor is not None:
-            seed |= coarse_snrs >= snr_floor - 0.75
-        seed_idx = np.flatnonzero(seed)
-        grown = np.unique(np.clip(seed_idx[:, None]
-                                  + np.arange(-1, 2)[None, :], 0, ndm - 1))
-        rescore(grown)
-
-    # 3. guarantee loop.  An unrescored row j can only beat the exact
-    # best if its coarse score understated it (exact_j <= coarse_j + U,
-    # U the true max underestimate), so the margin is one-sided: the
-    # overestimate side (coarse > exact, typical of wing rows whose
-    # nearest coarse neighbour is the peak) must NOT widen it —
-    # overestimated rows are already inside any coarse >= cutoff set.
-    # U itself is estimated two ways and the wider wins:
-    #  * adaptively, 1.5x the worst underestimate observed on rescored
-    #    rows (a biased, peak-clustered sample — hence also:)
-    #  * a structural trust bound: the tree's track rounding deviates
-    #    <= ~2 samples/channel (Zackay & Ofek 2017 sec 2.3), which for
-    #    a width-w boxcar-scored pulse costs at most ~1/sqrt(3) of its
-    #    S/N — so any row with coarse >= (1 - HYBRID_COARSE_TRUST) *
-    #    best could in principle hide the true best and is rescored.
-    for _round in range(HYBRID_MAX_ROUNDS):
-        under = (snrs[exact] - coarse_snrs[exact]).max(initial=0.0)
-        best_exact = snrs[exact].max()
-        margin = max(1.5 * under, HYBRID_COARSE_TRUST * best_exact, 0.25)
-        need = (~exact) & (coarse_snrs >= best_exact - margin)
-        if snr_floor is not None:
-            need |= (~exact) & (coarse_snrs >= snr_floor - 0.75)
-        todo = np.flatnonzero(need)
-        if todo.size == 0:
-            break
-        rescore(todo)
-    else:
-        todo = np.flatnonzero(
-            (~exact) & (coarse_snrs >= snrs[exact].max() - 0.25))
-        if todo.size:
-            rescore(todo)
+    hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
+                          snr_floor=snr_floor, seed_done=fused_seed)
     logger.debug("hybrid: %d/%d rows rescored exactly", exact.sum(), ndm)
 
     return maxvalues, stds, snrs, windows, peaks, exact, plane

@@ -104,8 +104,7 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     import jax.numpy as jnp
 
     dtype = dtype or jnp.float32
-    data = np.asarray(data)
-    nchan, nsamples = data.shape
+    nchan, nsamples = np.shape(data)
     if trial_dms is None:
         trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
                                       bandwidth, sample_time)
@@ -121,7 +120,14 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     # no-ops for the channel sum)
     offsets, _ = pad_to_multiple(offsets, 0, dm_size, mode="edge")
     offsets, _ = pad_to_multiple(offsets, 1, chan_size, mode="constant")
-    data_padded, _ = pad_to_multiple(data, 0, chan_size, mode="constant")
+    if nchan % chan_size:
+        data_padded, _ = pad_to_multiple(np.asarray(data), 0, chan_size,
+                                         mode="constant")
+    else:
+        # already aligned: keep the caller's array — a device-resident
+        # input (e.g. the sharded hybrid's repeated rescore calls) must
+        # not bounce through the host on every call
+        data_padded = data
 
     if chan_block is None:
         chan_block = auto_chan_block(data_padded.shape[0] // chan_size,

@@ -42,7 +42,8 @@ from ..ops.fdmt import (
 )
 from ..utils.table import ResultTable
 
-__all__ = ["sharded_fdmt_search", "slice_delay_range"]
+__all__ = ["sharded_fdmt_search", "sharded_hybrid_search",
+           "slice_delay_range"]
 
 
 def slice_delay_range(n_lo, n_hi, n_slices):
@@ -229,4 +230,75 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
         "snr": snrs,
         "rebin": wins,
         "peak": peaks,
+    })
+
+
+def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
+                          sample_time, mesh, snr_floor=None):
+    """Hybrid (exact hits at coarse cost) over a ``(dm, chan)`` mesh.
+
+    Multi-device composition of ``dedispersion_search(kernel="hybrid")``:
+    the coarse stage is the DM-sliced sharded FDMT (the ``chan`` axis is
+    idle/replicated there — use ``chan=1`` meshes when the coarse stage
+    dominates), and the exact rescore of candidate rows runs through
+    :func:`~pulsarutils_tpu.parallel.sharded.sharded_dedispersion_search`
+    over the full mesh.  The guarantee loop (one-sided margin + coarse-
+    trust bound) is shared with the single-device hybrid, so the hit-
+    detection contract is identical: the returned argbest row holds the
+    exact kernel's scores, with an ``exact`` column marking exact rows.
+    """
+    import jax.numpy as jnp
+
+    from ..ops.plan import dedispersion_plan
+    from ..ops.search import (
+        hybrid_guarantee_loop,
+        iter_rescore_buckets,
+        nearest_rows,
+    )
+    from .sharded import sharded_dedispersion_search
+
+    nchan = np.shape(data)[0]
+    # ONE host->device transfer: the coarse stage and every rescore call
+    # reuse the same device-resident array (sharded_dedispersion_search
+    # passes aligned device inputs through untouched)
+    data = jnp.asarray(data, jnp.float32)
+    t_coarse = sharded_fdmt_search(data, dmmin, dmmax, start_freq,
+                                   bandwidth, sample_time, mesh, axis="dm")
+    trial_dms = np.asarray(dedispersion_plan(
+        nchan, dmmin, dmmax, start_freq, bandwidth, sample_time),
+        dtype=np.float64)
+    ndm = len(trial_dms)
+    idx = nearest_rows(np.asarray(t_coarse["DM"]), trial_dms)
+
+    maxvalues = np.asarray(t_coarse["max"], np.float64)[idx]
+    stds = np.asarray(t_coarse["std"], np.float64)[idx]
+    snrs = np.asarray(t_coarse["snr"], np.float64)[idx]
+    windows = np.asarray(t_coarse["rebin"], np.int32)[idx]
+    peaks = np.asarray(t_coarse["peak"], np.int64)[idx]
+    coarse_snrs = snrs.copy()
+    exact = np.zeros(ndm, dtype=bool)
+
+    def rescore(rows):
+        for blk, padded in iter_rescore_buckets(rows):
+            t_ex = sharded_dedispersion_search(
+                data, dmmin, dmmax, start_freq, bandwidth, sample_time,
+                mesh=mesh, trial_dms=trial_dms[padded])
+            k = len(blk)
+            maxvalues[blk] = np.asarray(t_ex["max"])[:k]
+            stds[blk] = np.asarray(t_ex["std"])[:k]
+            snrs[blk] = np.asarray(t_ex["snr"])[:k]
+            windows[blk] = np.asarray(t_ex["rebin"])[:k]
+            peaks[blk] = np.asarray(t_ex["peak"])[:k]
+            exact[blk] = True
+
+    hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
+                          snr_floor=snr_floor)
+    return ResultTable({
+        "DM": trial_dms,
+        "max": maxvalues,
+        "std": stds,
+        "snr": snrs,
+        "rebin": windows,
+        "peak": peaks,
+        "exact": exact,
     })

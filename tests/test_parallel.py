@@ -303,3 +303,28 @@ class TestShardedFdmt:
                                    use_pallas=True)
         assert np.allclose(t_pl["snr"], t_xla["snr"], rtol=1e-5, atol=1e-5)
         assert t_pl.argbest() == t_xla.argbest()
+
+    def test_sharded_hybrid_matches_numpy_hits(self):
+        # multi-device hybrid: coarse sharded FDMT + sharded exact
+        # rescore must land on the NumPy reference's argbest row
+        from pulsarutils_tpu.models.simulate import simulate_test_data
+        from pulsarutils_tpu.ops.search import dedispersion_search
+        from pulsarutils_tpu.parallel.mesh import make_mesh
+        from pulsarutils_tpu.parallel.sharded_fdmt import (
+            sharded_hybrid_search,
+        )
+
+        array, header = simulate_test_data(150, nchan=64, nsamples=4096,
+                                           signal=2.0, noise=0.4, rng=51)
+        args = (100, 200.0, header["fbottom"], header["bandwidth"],
+                header["tsamp"])
+        mesh = make_mesh((4, 2), ("dm", "chan"))
+        t_h = sharded_hybrid_search(array, *args, mesh=mesh)
+        t_np = dedispersion_search(array, *args, backend="numpy")
+        assert t_h.nrows == t_np.nrows
+        best = t_np.argbest("snr")
+        assert t_h.argbest("snr") == best
+        assert bool(t_h["exact"][best])
+        assert t_h["DM"][best] == t_np["DM"][best]
+        assert t_h["rebin"][best] == t_np["rebin"][best]
+        assert np.isclose(t_h["snr"][best], t_np["snr"][best], rtol=1e-3)
