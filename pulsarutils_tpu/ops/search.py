@@ -718,7 +718,7 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         gather kernel elsewhere."""
         for blk, padded in iter_rescore_buckets(rows):
             if use_fused:
-                run = _fused_rescore_kernel(max_off, bucket)
+                run = _fused_rescore_kernel(max_off, len(padded))
                 stacked = run(data32, jnp.asarray(rebased_full[padded]))
                 m, s, b_, w, p = unstack_scores(stacked)
                 p = (p - roll_k) % nsamples  # undo the rebase rotation
