@@ -315,6 +315,15 @@ def main():
                 "rescored_rows": int(np.count_nonzero(table["exact"])),
             }
             log(f"exact_hit_match: {exact_hit_match}")
+            # the verification GATES the headline: any failed field marks
+            # the artifact degraded (a silently-false boolean in the JSON
+            # would ship an exactness regression as a green benchmark)
+            failed = [k for k, v in exact_hit_match.items()
+                      if isinstance(v, bool) and not v]
+            if failed:
+                msg = (f"exact_hit_match FAILED on {failed}: the hybrid's "
+                       "best row does not match the exact sweep")
+                degraded = "; ".join(filter(None, [degraded, msg]))
             secondary.append({
                 "kernel": "pallas (full exact sweep)",
                 "trials_per_sec": round(tps2, 1),
@@ -323,6 +332,12 @@ def main():
             })
         except Exception as exc:
             log(f"secondary pallas metric skipped: {exc!r}")
+        if exact_hit_match is None:
+            # the gate only gates if it actually ran: an exact sweep that
+            # crashed must not let the hybrid headline ship unverified
+            degraded = "; ".join(filter(None, [
+                degraded, "exact_hit_match verification DID NOT RUN "
+                          "(exact pallas sweep failed)"]))
         try:
             t3, tps3, dt3 = measure_kernel(device_array, "fdmt")
             secondary.append({

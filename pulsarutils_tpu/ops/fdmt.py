@@ -292,6 +292,24 @@ def _transform_setup(data, use_pallas):
             jax.default_backend() != "tpu", t)
 
 
+def _merge_row_block():
+    # guarded like PUTPU_FDMT_TILE: a malformed value must not crash the
+    # import (ValueError) or the padding math later (0/negative ->
+    # ZeroDivisionError in the (-rows) % row_block pads)
+    raw = os.environ.get("PUTPU_MERGE_ROW_BLOCK")
+    try:
+        value = int(raw or 0)
+    except ValueError:
+        value = 0
+    if raw and not 0 < value <= 256:
+        import warnings
+
+        warnings.warn(
+            f"PUTPU_MERGE_ROW_BLOCK={raw!r} ignored (needs an int in "
+            "[1, 256]); using 32", stacklevel=2)
+    return value if 0 < value <= 256 else 32
+
+
 #: output rows processed per merge-kernel grid step; amortises the
 #: per-step Pallas/DMA orchestration overhead (the kernel is otherwise
 #: grid-overhead-bound: one row per step = ~1.4M steps per transform).
@@ -300,8 +318,9 @@ def _transform_setup(data, use_pallas):
 #: 0.394 s; 64 @ 8192 exhausts scoped VMEM; tile size still dominates
 #: (8192 >> 4096 >> 2048).  Compile is slower at 32 (~25 s cold) but the
 #: persistent compilation cache amortises it.  Overridable via env
-#: ``PUTPU_MERGE_ROW_BLOCK`` (tuning/bisection without code edits).
-MERGE_ROW_BLOCK = int(os.environ.get("PUTPU_MERGE_ROW_BLOCK", 32))
+#: ``PUTPU_MERGE_ROW_BLOCK`` (an int in [1, 256]; anything else warns
+#: and falls back to 32) — tuning/bisection without code edits.
+MERGE_ROW_BLOCK = _merge_row_block()
 
 
 @functools.lru_cache(maxsize=64)

@@ -473,8 +473,13 @@ def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
             break
         rescore(todo)
     else:
-        todo = np.flatnonzero(
-            (~exact) & (coarse_snrs >= snrs[exact].max() - 0.25))
+        # round budget exhausted: rescore EVERY remaining row, exactly as
+        # documented at HYBRID_MAX_ROUNDS — a narrower criterion here
+        # (e.g. best_exact - 0.25) could leave a row whose coarse score
+        # understates the true best unrescored, silently voiding the
+        # exact-hit guarantee in precisely the pathological cases this
+        # cap exists for
+        todo = np.flatnonzero(~exact)
         if todo.size:
             rescore(todo)
 
