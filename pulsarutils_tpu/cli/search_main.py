@@ -17,6 +17,15 @@ def build_parser():
         description="Clean filterbank data and search for FRBs/single pulses")
     parser.add_argument("fnames", nargs="+",
                         help="input SIGPROC filterbank files")
+    def _snr_threshold(value):
+        if value in ("auto", "certifiable"):
+            return value
+        try:
+            return float(value)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{value!r}: expected a number, 'auto' or 'certifiable'")
+
     parser.add_argument("--dmmin", type=float, default=300.0)
     parser.add_argument("--dmmax", type=float, default=400.0)
     parser.add_argument("--sample-time", type=float, default=None,
@@ -27,7 +36,13 @@ def build_parser():
                              "crossing delay at dmmax")
     parser.add_argument("--tmin", type=float, default=0.0,
                         help="skip data before this time (s)")
-    parser.add_argument("--snr-threshold", type=float, default=6.0)
+    parser.add_argument("--snr-threshold", type=_snr_threshold, default=6.0,
+                        help="hit criterion: a number (reference default "
+                             "6), 'auto' (noise-ceiling-matched floor for "
+                             "the chunk geometry) or 'certifiable' (the "
+                             "lowest floor whose hybrid noise certificate "
+                             "fires on signal-free chunks — the survey "
+                             "fast path with --kernel hybrid)")
     parser.add_argument("--surelybad", type=int, nargs="*", default=[])
     parser.add_argument("--backend", choices=("jax", "numpy"), default="jax")
     parser.add_argument("--kernel",

@@ -24,24 +24,31 @@ def main(args=None):
                         help="channel indices to force-flag")
     parser.add_argument("--plot", metavar="OUT.png", default=None,
                         help="save a bandpass diagnostic plot")
+    parser.add_argument("--show", action="store_true",
+                        help="additionally display the bandpass figure in "
+                             "an interactive window when a display exists "
+                             "(the reference's show=True behaviour, "
+                             "stats.py:80-89); a no-op on headless hosts")
     opts = parser.parse_args(args)
 
     for fname in opts.fnames:
         # one pass over the file serves both flagging and plotting
-        spectra = get_spectral_stats(fname) if opts.plot else None
+        spectra = (get_spectral_stats(fname)
+                   if opts.plot or opts.show else None)
         mask = get_bad_chans(fname, surelybad=opts.surelybad,
                              refresh=opts.refresh, spectra=spectra)
         logger.info("%s: %d bad channels: %s", fname, mask.sum(),
                     np.flatnonzero(mask).tolist())
-        if opts.plot:
-            _plot_bandpass(spectra, mask, opts.plot)
+        if opts.plot or opts.show:
+            _plot_bandpass(spectra, mask, opts.plot, show=opts.show)
     return 0
 
 
-def _plot_bandpass(spectra, mask, outname):
+def _plot_bandpass(spectra, mask, outname, show=False):
     import matplotlib
 
-    matplotlib.use("Agg", force=False)
+    if not show:
+        matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt
 
     mean_spec, std_spec = spectra
@@ -53,9 +60,12 @@ def _plot_bandpass(spectra, mask, outname):
         ax.plot(chans[mask], spec[mask], "rx", ms=4)
         ax.set_ylabel(f"{label} bandpass")
     axes[1].set_xlabel("channel")
-    fig.savefig(outname, bbox_inches="tight")
+    if outname:
+        fig.savefig(outname, bbox_inches="tight")
+        logger.info("bandpass plot -> %s", outname)
+    if show:
+        plt.show()  # no-op under non-interactive backends (headless)
     plt.close(fig)
-    logger.info("bandpass plot -> %s", outname)
 
 
 if __name__ == "__main__":  # python -m pulsarutils_tpu.cli.stats_main

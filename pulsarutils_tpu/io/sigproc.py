@@ -131,9 +131,19 @@ class FilterbankReader:
     **ascending frequency order** when ``band_ascending=True`` (default
     False returns file order) — the reference flips descending bands by
     hand in its chunk loop (``clean.py:332-333``); the flag folds that in.
+
+    Multi-IF files (``nifs > 1`` — polarisation/IF planes interleaved
+    per time frame as ``[t][if][chan]``, the SIGPROC layout) are
+    supported natively (the reference inherited this from sigpyproc's
+    ``FilReader``, used at ``clean.py:284-294`` / ``stats.py:37``):
+    ``if_mode`` selects what ``read_block`` returns —
+
+    * ``"sum"`` (default): total intensity, the IF planes summed — what
+      a single-pulse search wants from e.g. dual-polarisation data;
+    * an integer ``k``: IF plane ``k`` alone.
     """
 
-    def __init__(self, path):
+    def __init__(self, path, if_mode="sum"):
         self.path = path
         raw_header, offset = read_header(path)
         data_size = os.path.getsize(path) - offset
@@ -141,26 +151,32 @@ class FilterbankReader:
         nbits = self.header.get("nbits", 32)
         self._nbits = nbits
         nifs = self.header.get("nifs", 1)
-        if nifs != 1:
-            raise NotImplementedError("nifs > 1 not supported")
+        self.nifs = nifs
+        if if_mode != "sum":
+            k = int(if_mode)
+            if not 0 <= k < nifs:
+                raise ValueError(f"if_mode={if_mode!r}: file has {nifs} "
+                                 "IF planes")
+        self.if_mode = if_mode
         nchans = self.header["nchans"]
+        width = nifs * nchans  # values per time frame
         if nbits in (1, 2, 4):
             # packed low-bit samples: mmap the raw bytes, unpack per block
             # (native C loop when available — io/lowbit.py)
-            if (nchans * nbits) % 8:
+            if (width * nbits) % 8:
                 raise ValueError(
-                    f"nchans={nchans} at nbits={nbits} does not pack to "
-                    "whole bytes")
+                    f"nchans={nchans} x nifs={nifs} at nbits={nbits} does "
+                    "not pack to whole bytes")
             self._mmap = np.memmap(
                 path, dtype=np.uint8, mode="r", offset=offset,
-                shape=(self.header["nsamples"], nchans * nbits // 8))
+                shape=(self.header["nsamples"], width * nbits // 8))
         elif nbits in _DTYPES:
             self._dtype = _DTYPES[nbits]
             if nbits == 8 and self.header.get("signed"):
                 self._dtype = np.int8  # sigproc ``signed`` char flag
             self._mmap = np.memmap(path, dtype=self._dtype, mode="r",
                                    offset=offset,
-                                   shape=(self.header["nsamples"], nchans))
+                                   shape=(self.header["nsamples"], width))
         else:
             raise ValueError(f"unsupported nbits={nbits}")
 
@@ -183,10 +199,17 @@ class FilterbankReader:
         if self._nbits in (1, 2, 4):
             from .lowbit import unpack
 
-            block = unpack(raw, self._nbits).reshape(
-                nsamps, self.nchans).T.astype(float)
+            frames = unpack(raw, self._nbits).reshape(
+                nsamps, self.nifs, self.nchans).astype(float)
         else:
-            block = raw.T.astype(float)
+            frames = raw.reshape(nsamps, self.nifs,
+                                 self.nchans).astype(float)
+        if self.nifs == 1:
+            block = frames[:, 0].T
+        elif self.if_mode == "sum":
+            block = frames.sum(axis=1).T
+        else:
+            block = frames[:, int(self.if_mode)].T
         if band_ascending and self.band_descending:
             block = block[::-1]
         return block
@@ -207,18 +230,24 @@ class FilterbankReader:
 
 
 class FilterbankWriter:
-    """Streaming SIGPROC filterbank writer (time-major frames)."""
+    """Streaming SIGPROC filterbank writer (time-major frames).
+
+    With ``nifs > 1`` in the header, :meth:`write_block` takes
+    ``(nifs, nchans, n)`` blocks and interleaves the IF planes per time
+    frame (the SIGPROC ``[t][if][chan]`` layout the reader expects).
+    """
 
     def __init__(self, path, header):
         self.path = path
         self.header = dict(header)
         self.nchans = int(self.header["nchans"])
+        self.nifs = int(self.header.get("nifs", 1))
         self.nbits = int(self.header.get("nbits", 32))
         if self.nbits in (1, 2, 4):
-            if (self.nchans * self.nbits) % 8:
+            if (self.nifs * self.nchans * self.nbits) % 8:
                 raise ValueError(
-                    f"nchans={self.nchans} at nbits={self.nbits} does not "
-                    "pack to whole bytes")
+                    f"nchans={self.nchans} x nifs={self.nifs} at "
+                    f"nbits={self.nbits} does not pack to whole bytes")
             self._dtype = np.uint8
         elif self.nbits in _DTYPES:
             self._dtype = _DTYPES[self.nbits]
@@ -237,24 +266,37 @@ class FilterbankWriter:
         self._file.write(_pack_string("HEADER_END"))
 
     def write_block(self, block):
-        """Write a ``(nchans, n)`` block (channel-major in, time-major out)."""
+        """Write a ``(nchans, n)`` block (channel-major in, time-major
+        out), or ``(nifs, nchans, n)`` for a multi-IF file."""
         block = np.asarray(block)
-        if block.shape[0] != self.nchans:
-            raise ValueError(f"block has {block.shape[0]} channels, "
-                             f"expected {self.nchans}")
-        frames = np.ascontiguousarray(block.T)
+        if self.nifs > 1:
+            if block.ndim != 3 or block.shape[:2] != (self.nifs,
+                                                      self.nchans):
+                raise ValueError(
+                    f"multi-IF block must be ({self.nifs}, {self.nchans}, "
+                    f"n); got {block.shape}")
+            nsamps = block.shape[2]
+            frames = np.ascontiguousarray(
+                block.transpose(2, 0, 1)).reshape(nsamps,
+                                                  self.nifs * self.nchans)
+        else:
+            if block.shape[0] != self.nchans:
+                raise ValueError(f"block has {block.shape[0]} channels, "
+                                 f"expected {self.nchans}")
+            nsamps = block.shape[1]
+            frames = np.ascontiguousarray(block.T)
         if self.nbits in (1, 2, 4):
             from .lowbit import pack
 
             frames = pack(frames, self.nbits)  # clips to [0, 2^nbits - 1]
             self._file.write(frames.tobytes())
-            self._nsamples_written += block.shape[1]
+            self._nsamples_written += nsamps
             return
         if self.nbits < 32:
             info = np.iinfo(self._dtype)
             frames = np.clip(np.rint(frames), info.min, info.max)
         self._file.write(frames.astype(self._dtype).tobytes())
-        self._nsamples_written += block.shape[1]
+        self._nsamples_written += nsamps
 
     def close(self):
         if not self._file.closed:

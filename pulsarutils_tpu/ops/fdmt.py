@@ -214,6 +214,39 @@ def fdmt_plan(nchan, start_freq, bandwidth, max_delay, min_delay=0):
     return FdmtPlan(nchan, start_freq, bandwidth, max_delay, min_delay)
 
 
+def fdmt_tracks(plan):
+    """The effective dispersion track of every final transform row.
+
+    Walks the plan's merge tables with an offset accumulator instead of
+    data: row ``r`` of the transform computes exactly
+    ``out[t] = sum_c data[c, (t + tracks[r, c]) mod T]`` (the same gather
+    convention as the exact kernels, :mod:`.dedisperse`), so comparing
+    ``tracks`` against :func:`~pulsarutils_tpu.ops.plan.dedispersion_shifts`
+    gives the tree's per-channel track rounding *exactly* — no data, no
+    noise, no device.  Consumers: the hybrid's per-config retention bound
+    (:mod:`.certify`) and the track-deviation tests.
+
+    Returns int64 ``(rows_final, nchan_padded)``; rows are the plan's
+    ``min_delay..max_delay`` delay slice, columns ``>= plan.nchan`` belong
+    to zero-padded channels (no data flows through them — slice them off
+    before comparing).
+    """
+    nchp = plan.nchan_padded
+    tracks = np.zeros((nchp, nchp), np.int64)
+    valid = np.eye(nchp, dtype=bool)
+    for it in plan.iterations:
+        tl = tracks[it["idx_low"]] + it["shift"][:, None]
+        th = tracks[it["idx_high"]]
+        if it["shift_high"] is not None:
+            th = th + it["shift_high"][:, None]
+        vl, vh = valid[it["idx_low"]], valid[it["idx_high"]]
+        # low/high parents cover disjoint channel halves of the output band
+        tracks = np.where(vl, tl, th) * (vl | vh)
+        valid = vl | vh
+    assert valid.all(), "final band must cover every channel"
+    return tracks
+
+
 def max_band_delay(nchan, dmmax, start_freq, bandwidth, sample_time):
     """Largest integer band-crossing delay for ``dmmax`` (plan row count)."""
     return int(np.ceil(
@@ -484,7 +517,7 @@ def _merge_pallas(state, it, t_tile, interpret):
 @functools.lru_cache(maxsize=16)
 def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                   use_pallas, interpret, n_lo=0, with_scores=False,
-                  with_plane=True, t_orig=None):
+                  with_plane=True, t_orig=None, with_cert=False):
     """The traceable (un-jitted) transform body: DM-pruned merges
     [+ scoring].  :func:`_build_transform` wraps it in ``jax.jit``;
     the hybrid search composes it with its fused seed-rescore program
@@ -525,8 +558,9 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
 
         # row-chunked scoring bounds the scorer's HBM temps (see
         # score_profiles_chunked) while still emitting ONE (5, ndm)
-        # array -> one host readback round trip over the tunnel
-        stacked = score_profiles_chunked(plane, jnp)
+        # array ((6, ndm) with the hybrid's certificate row) -> one host
+        # readback round trip over the tunnel
+        stacked = score_profiles_chunked(plane, jnp, with_cert=with_cert)
         return (stacked, plane) if with_plane else stacked
 
     return fn
@@ -535,14 +569,15 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
 @functools.lru_cache(maxsize=16)
 def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                      use_pallas, interpret, n_lo=0, with_scores=False,
-                     with_plane=True, t_orig=None):
+                     with_plane=True, t_orig=None, with_cert=False):
     """Jitted wrapper of :func:`_transform_fn` (same signature)."""
     import jax
 
     return jax.jit(_transform_fn(nchan, start_freq, bandwidth, max_delay,
                                  t, t_tile, use_pallas, interpret,
                                  n_lo=n_lo, with_scores=with_scores,
-                                 with_plane=with_plane, t_orig=t_orig))
+                                 with_plane=with_plane, t_orig=t_orig,
+                                 with_cert=with_cert))
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,8 @@ TPU notes — two device paths:
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 
 import numpy as np
 
@@ -57,6 +59,79 @@ FOURIER_CHAN_BLOCK = 128
 #: scan's rotation carry is chan_block * (T/2+1) complex64 and each
 #: superblock materialises a (superblock, T/2+1) spectrum accumulator
 FOURIER_SUPERBLOCK = 64
+
+#: HBM budget (bytes) the FDD's live-set estimate must fit in; oversized
+#: blocking requests are auto-shrunk (with a warning) instead of
+#: compile-OOMing the chip — the FDD analogue of the Pallas kernel's
+#: VMEM_BUDGET.  Default 12 GB leaves headroom on a 16 GB chip for the
+#: allocator and XLA's FFT temporaries; override via PUTPU_FDD_HBM.
+FDD_HBM_BUDGET = 12 << 30
+
+
+def _fdd_hbm_budget():
+    raw = os.environ.get("PUTPU_FDD_HBM")
+    try:
+        value = int(float(raw or 0))
+    except (ValueError, OverflowError):  # "8GB", "inf", ...
+        value = 0
+    if raw and value <= (1 << 28):
+        # mirror the PUTPU_MERGE_ROW_BLOCK guard: a rejected override
+        # must not silently budget for the 12 GB default on a smaller
+        # chip (the compile-OOM this knob exists to prevent)
+        warnings.warn(
+            f"PUTPU_FDD_HBM={raw!r} ignored (needs a byte count "
+            "> 2^28, e.g. 8589934592 for 8 GB); using the "
+            f"{FDD_HBM_BUDGET >> 30} GB default", stacklevel=2)
+    return value if value > (1 << 28) else FDD_HBM_BUDGET
+
+
+def _fdd_live_bytes(nchan, t, superblock, chan_block, cross=False):
+    """Conservative live-set estimate of an FDD program.
+
+    Counts the resident spectrum (complex64, the irreducible term), the
+    float32 input, the per-channel-block phasors (anchor, step, carry,
+    spectrum slice), the superblock accumulators, and a 2x allowance on
+    the superblock-sized irfft for XLA's FFT temporaries.  ``cross=True``
+    adds the arbitrary-grid fallback's dominant
+    ``dm_block x chan_block x nbin`` complex phase tensor (the
+    uniform-grid kernel never materialises that cross term).
+    """
+    nbin = t // 2 + 1
+    nchan_p = -(-nchan // chan_block) * chan_block
+    spec = 8 * nchan_p * nbin
+    data = 4 * nchan_p * t
+    phasors = 8 * nbin * 4 * chan_block
+    acc = 8 * nbin * 3 * superblock
+    fft = 2 * 4 * superblock * t
+    phase_cross = 2 * 8 * superblock * chan_block * nbin if cross else 0
+    return spec + data + phasors + acc + fft + phase_cross
+
+
+def _auto_fdd_blocks(nchan, t, superblock, chan_block, cross=False):
+    """Shrink (superblock, chan_block) until the estimate fits the HBM
+    budget; returns the (possibly reduced) pair."""
+    budget = _fdd_hbm_budget()
+    req = (superblock, chan_block)
+    min_s = 1 if cross else 8
+    while (_fdd_live_bytes(nchan, t, superblock, chan_block, cross)
+           > budget and (superblock > min_s or chan_block > 32)):
+        # shrink whichever block contributes more shrinkable bytes
+        # (uniform path: superblock terms ~ 20*S*t vs chan terms
+        # ~ 16*C*t; with the cross term both shrink it equally, so the
+        # same dominance rule still picks the bigger contributor)
+        if chan_block <= 32 or (superblock > min_s
+                                and 20 * superblock >= 16 * chan_block):
+            superblock //= 2
+        else:
+            chan_block //= 2
+    if (superblock, chan_block) != req:
+        warnings.warn(
+            f"FDD blocking {req} exceeds the HBM budget "
+            f"({_fdd_live_bytes(nchan, t, *req, cross) >> 30} GB est. > "
+            f"{budget >> 30} GB); shrunk to "
+            f"({superblock}, {chan_block}) — set PUTPU_FDD_HBM to raise",
+            stacklevel=3)
+    return superblock, chan_block
 
 
 def fractional_delays(trial_dms, nchan, start_freq, bandwidth):
@@ -378,7 +453,12 @@ def _fourier_device_run(data, trial_dms, start_freq, bandwidth, sample_time,
     dm_step = _uniform_spacing(trial_dms)
     if dm_step is not None:
         superblock = dm_block or FOURIER_SUPERBLOCK
+        # clamp to the trial count BEFORE the budget check: a 512-block
+        # request over 8 trials would otherwise warn and shrink
+        # chan_block for a program that was never going to be built
         superblock = max(1, min(superblock, len(np.atleast_1d(trial_dms))))
+        superblock, chan_block = _auto_fdd_blocks(nchan, t, superblock,
+                                                  chan_block)
         anchor_limbs, step_limbs, ndm = _uniform_fourier_inputs(
             trial_dms, dm_step, nchan, start_freq, bandwidth, sample_time,
             t, superblock)
@@ -389,7 +469,10 @@ def _fourier_device_run(data, trial_dms, start_freq, bandwidth, sample_time,
     else:
         delays = fractional_delays(trial_dms, nchan, start_freq, bandwidth)
         ndm = delays.shape[0]
-        run = _jitted_fourier(t, dm_block or FOURIER_DM_BLOCK, chan_block,
+        dm_block, chan_block = _auto_fdd_blocks(
+            nchan, t, min(dm_block or FOURIER_DM_BLOCK, max(1, ndm)),
+            chan_block, cross=True)
+        run = _jitted_fourier(t, dm_block, chan_block,
                               with_scores, with_plane)
         out = run(jnp.asarray(data, jnp.float32),
                   jnp.asarray(_phase_limbs(delays, sample_time, t)))

@@ -44,6 +44,14 @@ from ..utils.table import ResultTable
 #: boxcar widths tried by the scorer (reference ``dedispersion.py:190-191``)
 SEARCH_WINDOWS = (1, 2, 4, 8)
 
+#: sliding windows of the hybrid's certificate scorer.  SOUNDNESS
+#: COUPLING: :func:`cert_profile_scores` unrolls exactly these widths
+#: structurally, and ``certify._cert_retention_from_offsets`` computes
+#: the retention bound over the same set — change all three together or
+#: the noise certificate's bound no longer describes the scorer
+#: (``tests/test_certify.py`` pins the coupling).
+CERT_WINDOWS = (2, 3, 4)
+
 
 def score_profiles(plane, xp=np):
     """Score a block of dedispersed series ``(ndm, T)``.
@@ -95,7 +103,34 @@ def score_profiles_stacked(plane, xp=np):
     return xp.stack([s.astype(dtype) for s in scores])
 
 
-def score_profiles_chunked(plane, xp, chunk=512):
+def cert_profile_scores(plane, xp=np):
+    """Sliding-window certificate score per row of a (coarse) plane.
+
+    ``max_t (x * box_w)(t) / (std * sqrt(w))`` for ``w`` in (2, 3, 4)
+    over ALL alignments (sliding, circular) — unlike the detection scorer's
+    non-sliding block sums, this capture is pulse-phase-invariant, which
+    is what makes the hybrid's structural bounds usable: a pulse whose
+    energy the tree scatters over a few adjacent bins always shows a
+    sliding-window capture near its full mass, whereas a block boxcar at
+    the worst phase splits it (the difference between a worst-case
+    retention of ~0.6 and ~0.44 at the benchmark config — see
+    :mod:`.certify`).  Used only on the hybrid's coarse plane; detection
+    scores keep the reference's block convention.
+    """
+    assert CERT_WINDOWS == (2, 3, 4), \
+        "cert_profile_scores structurally unrolls widths 2/3/4"
+    plane = xp.asarray(plane)
+    x = plane - plane.mean(axis=1, keepdims=True)
+    std = x.std(axis=1)
+    s2 = x + xp.roll(x, -1, axis=1)
+    best = s2.max(axis=1) / (std * np.float32(np.sqrt(2.0)))
+    s3 = s2 + xp.roll(x, -2, axis=1)
+    best = xp.maximum(best, s3.max(axis=1) / (std * np.float32(np.sqrt(3.0))))
+    s4 = s2 + xp.roll(s2, -2, axis=1)
+    return xp.maximum(best, s4.max(axis=1) / (std * np.float32(2.0)))
+
+
+def score_profiles_chunked(plane, xp, chunk=512, with_cert=False):
     """:func:`score_profiles_stacked` over row chunks of a large plane.
 
     Whole-plane scoring materialises the mean-subtracted copy plus four
@@ -103,20 +138,36 @@ def score_profiles_chunked(plane, xp, chunk=512):
     at multi-thousand-trial x long-T shapes on a 16 GB chip.  The
     statically-unrolled chunk loop bounds the scorer's live temps to
     ~``chunk/ndm`` of that, still emitting ONE ``(5, ndm)`` array (one
-    host readback round trip).
+    host readback round trip) — ``(6, ndm)`` with ``with_cert`` (the
+    hybrid's sliding certificate row appended).
     """
     rows = plane.shape[0]
+
+    def one(sub):
+        stacked = score_profiles_stacked(sub, xp=xp)
+        if with_cert:
+            stacked = xp.concatenate(
+                [stacked, cert_profile_scores(sub, xp=xp)[None]])
+        return stacked
+
     return xp.concatenate(
-        [score_profiles_stacked(plane[lo:min(lo + chunk, rows)], xp=xp)
+        [one(plane[lo:min(lo + chunk, rows)])
          for lo in range(0, rows, chunk)], axis=1)
 
 
 def unstack_scores(stacked):
-    """Host-side inverse of :func:`score_profiles_stacked` (one readback)."""
+    """Host-side inverse of :func:`score_profiles_stacked` (one readback).
+
+    Accepts the 5-row pack or the 6-row ``with_cert`` pack; the cert row
+    (when present) is returned as-is as a sixth element.
+    """
     stacked = np.asarray(stacked)
-    maxvalues, stds, best_snrs, wins, peaks = stacked
-    return (maxvalues, stds, best_snrs, np.rint(wins).astype(np.int32),
-            np.rint(peaks).astype(np.int64))
+    maxvalues, stds, best_snrs, wins, peaks = stacked[:5]
+    out = (maxvalues, stds, best_snrs, np.rint(wins).astype(np.int32),
+           np.rint(peaks).astype(np.int64))
+    if stacked.shape[0] > 5:
+        out = out + (stacked[5],)
+    return out
 
 
 #: soft cap on the gather workspace (elements) a single trial-block may
@@ -291,12 +342,13 @@ def _search_jax_pallas(data, offsets, capture_plane, dm_block=None,
 
 
 def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
-                     capture_plane):
+                     capture_plane, with_cert=False):
     """FDMT sweep: every integer-delay trial in one log-depth transform.
 
     Trial grid is the FDMT's natural (= the reference plan's) integer
     band-delay grid on ``[dmmin, dmmax]`` — see
-    :func:`pulsarutils_tpu.ops.fdmt.fdmt_trial_dms`.
+    :func:`pulsarutils_tpu.ops.fdmt.fdmt_trial_dms`.  ``with_cert``
+    appends the sliding certificate row (hybrid's coarse stage).
     """
     import jax.numpy as jnp
 
@@ -314,16 +366,20 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     run = _build_transform(nchan, float(start_freq), float(bandwidth),
                            n_hi, t_run, t_tile, use_pallas, interpret,
                            n_lo=n_lo, with_scores=True,
-                           with_plane=capture_plane, t_orig=t_orig)
+                           with_plane=capture_plane, t_orig=t_orig,
+                           with_cert=with_cert)
     out = run(data)
     if capture_plane:
         stacked, plane_out = out  # plane stays device-resident
     else:
         stacked, plane_out = out, None
-    (maxvalues, stds, best_snrs, best_windows,
-     best_peaks) = unstack_scores(stacked)
-    return (trial_dms, maxvalues, stds, best_snrs, best_windows, best_peaks,
-            plane_out)
+    scores = unstack_scores(stacked)
+    (maxvalues, stds, best_snrs, best_windows, best_peaks) = scores[:5]
+    out = (trial_dms, maxvalues, stds, best_snrs, best_windows, best_peaks,
+           plane_out)
+    if with_cert:
+        out = out + (scores[5],)
+    return out
 
 
 def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
@@ -402,8 +458,18 @@ HYBRID_MAX_ROUNDS = 20
 #: coarse S/N is within this fraction of the exact best gets rescored
 #: regardless of the adaptively-observed error (guards against the
 #: observed-error sample being biased toward the peak, where the coarse
-#: score tracks well)
-HYBRID_COARSE_TRUST = 0.45
+#: score tracks well).  MEASURED (round 3, ops/certify.py — worst-case
+#: retention computed exactly from the transform's own merge tables):
+#: at the 1024-chan / 1M-sample / DM 300-635 headline config the block
+#: detection scorer retains >= 0.436 of a worst-phase width-1 pulse's
+#: exact S/N (mean 0.60), so the matching margin fraction is
+#: 1 - 0.436 = 0.564 — the round-2 hand value of 0.45 was slightly
+#: optimistic at the worst phase and is corrected here.  This constant
+#: is only the FALLBACK for callers that do not supply the sliding
+#: certificate scores; the hybrid itself now uses the per-config
+#: phase-invariant bound (``certify.cert_retention``), which is both
+#: rigorous and tighter (~0.56 retention).
+HYBRID_COARSE_TRUST = 0.60
 
 
 def iter_rescore_buckets(rows):
@@ -441,17 +507,35 @@ def nearest_rows(sorted_grid, targets):
 
 
 def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
-                          snr_floor=None, seed_done=False):
+                          snr_floor=None, seed_done=False,
+                          cert_scores=None, rho_cert=None):
     """The hybrid's seed + guarantee iteration (see
     :func:`_search_jax_hybrid` for the full rationale).
 
-    ``snrs``/``exact`` are mutated in place by ``rescore(rows)``; the
-    loop terminates when no unrescored row's coarse estimate reaches
-    ``best_exact - margin``, with margin the wider of 1.5x the worst
-    *observed* coarse underestimate and the structural
-    :data:`HYBRID_COARSE_TRUST` bound.  ``seed_done=True`` skips the
-    seeding round (the fused TPU program already rescored it).
+    ``snrs``/``exact`` are mutated in place by ``rescore(rows)``.
+
+    With ``cert_scores``/``rho_cert`` supplied (the sliding certificate
+    row and the per-config retention bound, :mod:`.certify`), the loop
+    uses the RIGOROUS skip proof: row ``j`` is left unrescored only when
+    ``(cert_j + HYBRID_CERT_SLACK) / rho_cert < best_exact`` — an
+    impulsive signal beating the exact best would necessarily show a
+    certificate score above that line, so skipped rows provably cannot
+    hold the best hit.  This replaces the round-2 heuristic margins
+    (1.5x the *observed* underestimate — a peak-biased sample — and the
+    hand-set :data:`HYBRID_COARSE_TRUST` fraction), which the round-3
+    worst-case analysis showed could in principle skip a worst-phase
+    width-1 pulse.  Consequence worth knowing: on chunks whose best is
+    barely above the noise (no certificate, no bright pulse) the
+    rigorous criterion rescans honestly toward a full exact sweep — the
+    noise-certificate fast path, not the margin, is what makes
+    signal-free chunks cheap.
+
+    Without cert scores the legacy margins apply (conservative fallback
+    for callers that only have block coarse scores).  ``seed_done=True``
+    skips the seeding round (the fused TPU program already rescored it).
     """
+    from .certify import HYBRID_CERT_SLACK
+
     ndm = len(coarse_snrs)
     if not seed_done:
         seed = (coarse_snrs >= coarse_snrs.max() - 0.5)
@@ -461,13 +545,31 @@ def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
         grown = np.unique(np.clip(seed_idx[:, None]
                                   + np.arange(-1, 2)[None, :], 0, ndm - 1))
         rescore(grown)
+    rigorous = cert_scores is not None and rho_cert is not None
     for _round in range(HYBRID_MAX_ROUNDS):
-        under = (snrs[exact] - coarse_snrs[exact]).max(initial=0.0)
         best_exact = snrs[exact].max()
-        margin = max(1.5 * under, HYBRID_COARSE_TRUST * best_exact, 0.25)
-        need = (~exact) & (coarse_snrs >= best_exact - margin)
-        if snr_floor is not None:
-            need |= (~exact) & (coarse_snrs >= snr_floor - 0.75)
+        if rigorous:
+            need = (~exact) & (cert_scores
+                               >= rho_cert * best_exact - HYBRID_CERT_SLACK)
+            # consistency guard (mirrors certify_noise_only's): a row
+            # whose DISPLAYED coarse block score already beats the exact
+            # best must be rescored even if its sliding cert score is
+            # low (single-spike-with-negative-dips junk outside the
+            # impulsive model) — otherwise argbest could land on a
+            # non-exact row, breaking the exact-argbest contract
+            need |= (~exact) & (coarse_snrs >= best_exact)
+            if snr_floor is not None:
+                need |= (~exact) & (cert_scores >= rho_cert * snr_floor
+                                    - HYBRID_CERT_SLACK)
+                # same consistency guard for the floor contract: a row
+                # DISPLAYING an above-floor coarse score must be exact
+                need |= (~exact) & (coarse_snrs >= snr_floor)
+        else:
+            under = (snrs[exact] - coarse_snrs[exact]).max(initial=0.0)
+            margin = max(1.5 * under, HYBRID_COARSE_TRUST * best_exact, 0.25)
+            need = (~exact) & (coarse_snrs >= best_exact - margin)
+            if snr_floor is not None:
+                need |= (~exact) & (coarse_snrs >= snr_floor - 0.75)
         todo = np.flatnonzero(need)
         if todo.size == 0:
             break
@@ -482,6 +584,57 @@ def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
         todo = np.flatnonzero(~exact)
         if todo.size:
             rescore(todo)
+
+
+def hybrid_certificate_gate(cert_scores, coarse_snrs, snrs, exact, rescore,
+                            *, nchan, trial_dms, start_freq, bandwidth,
+                            sample_time, nsamples, snr_floor,
+                            noise_certificate, seed_done=False):
+    """The certificate check + guarantee loop, shared VERBATIM by the
+    single-device and sharded hybrids (their docstrings promise an
+    identical contract — this helper is what makes that true).
+
+    Owns the PAD-FREE soundness guard: on TPU a time axis no
+    power-of-two tile divides gets zero-padded inside the transform
+    (``fdmt._transform_setup``), gathers wrap through the pad instead
+    of circularly mod ``nsamples``, and the retention bound's circular
+    model no longer applies — neither the certificate nor the
+    cert-based skip proof may run, so the loop falls back to the
+    legacy conservative margins (and the retention bound is not even
+    computed — it could inform nothing).
+
+    Otherwise computes the per-config retention bound, certifies the
+    chunk signal-free when permitted (skipping the loop entirely), and
+    runs :func:`hybrid_guarantee_loop` with the rigorous cert-based
+    skip proof.  Returns ``(certified, rho_cert_min)`` —
+    ``rho_cert_min`` is ``None`` on padded runs.
+    """
+    import jax
+
+    from .certify import certify_noise_only, retention_bound
+    from .fdmt import _pick_fdmt_tile
+
+    if (jax.default_backend() == "tpu"
+            and _pick_fdmt_tile(int(nsamples)) == 0):
+        cert_scores = None
+        noise_certificate = False
+
+    rho_cert_min = None
+    certified = False
+    if cert_scores is not None:
+        rho_cert_min = retention_bound(nchan, trial_dms, start_freq,
+                                       bandwidth, sample_time, nsamples,
+                                       cert=True)
+        certified = bool(noise_certificate
+                         and certify_noise_only(cert_scores, snr_floor,
+                                                rho_cert_min,
+                                                coarse_snrs=coarse_snrs))
+    if not certified:
+        hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
+                              snr_floor=snr_floor, seed_done=seed_done,
+                              cert_scores=cert_scores,
+                              rho_cert=rho_cert_min)
+    return certified, rho_cert_min
 
 
 #: top-k coarse rows the fused seed program rescores device-side (plus
@@ -503,8 +656,9 @@ def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
     upload [cached instead], rescore readback) into one dispatch + one
     readback — each trip costs ~0.1 s on the tunnelled platform, the
     difference between ~650 and ~850 DM-trials/s at the benchmark shape.
-    Packing layout: ``[coarse (5*ndm_plan) | sel (bucket) |
-    exact (5*bucket)]`` (indices < 2^24 are exact in float32).
+    Packing layout: ``[coarse (6*ndm_plan) | sel (bucket) |
+    exact (5*bucket)]`` (indices < 2^24 are exact in float32); coarse
+    row 5 is the sliding certificate score (:func:`cert_profile_scores`).
     """
     import jax
     import jax.numpy as jnp
@@ -515,13 +669,13 @@ def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
     coarse_fn = _transform_fn(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, True, False, n_lo=n_lo,
                               with_scores=True, with_plane=False,
-                              t_orig=t_orig)
+                              t_orig=t_orig, with_cert=True)
     k = min(HYBRID_SEED_TOPK, ndm_plan)  # top_k requires k <= axis size
 
     @jax.jit
     def run(data, idx_map, offsets_rebased):
-        stacked_f = coarse_fn(data)               # (5, ndm_fdmt)
-        coarse = stacked_f[:, idx_map]            # (5, ndm_plan)
+        stacked_f = coarse_fn(data)               # (6, ndm_fdmt)
+        coarse = stacked_f[:, idx_map]            # (6, ndm_plan)
         _, top = jax.lax.top_k(coarse[2], k)
         sel = jnp.concatenate([top - 1, top, top + 1])
         sel = jnp.clip(sel, 0, ndm_plan - 1)
@@ -583,7 +737,7 @@ def _fused_rescore_kernel(max_off, dm_block):
 
 def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
                        capture_plane, dm_block, chan_block,
-                       snr_floor=None):
+                       snr_floor=None, noise_certificate=True):
     """FDMT coarse sweep + exact rescore of the hit region.
 
     The throughput/exactness trade (VERDICT round 1): the FDMT computes
@@ -616,13 +770,26 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
     hybrid is never *wrong*, just no faster than ``kernel="pallas"``
     when there is nothing to find in the chunk.
 
-    ``snr_floor`` (opt-in): additionally rescore every row whose coarse
-    S/N reaches ``snr_floor - 0.75``, making *all* above-threshold
-    detections exact, not just the best.  Off by default because it is
-    only affordable when the floor sits clearly above the noise
-    expectation ``~sqrt(2 ln T)`` — at T = 2^20 samples the reference's
-    ``snr > 6`` floor (``clean.py:349``) is a mere 0.5 above the noise
-    max, and chasing it degenerates into a full exact sweep.
+    ``snr_floor`` (opt-in): additionally rescore every row that could
+    hold an above-floor detection (sliding certificate score within the
+    per-config retention bound of the floor, :mod:`.certify`), making
+    *all* above-threshold detections exact, not just the best — and,
+    with ``noise_certificate`` (default on), enabling the noise
+    certificate: when NO trial's certificate score reaches
+    ``rho_cert * snr_floor - HYBRID_CERT_SLACK``, the chunk provably
+    holds no impulsive signal detectable at the floor, the guarantee
+    loop is skipped entirely, and the coarse table is returned with
+    ``meta["certified"] = True`` (its rows are then coarse scores, NOT
+    exact — the certificate's claim is strictly the absence of
+    detections).  On survey data this is the difference between the
+    hybrid degenerating to a full exact sweep on every signal-free
+    chunk and paying one tree transform per such chunk.  Note the floor
+    must sit at ``certify.certifiable_snr_floor`` (~12 at 1M-sample
+    chunks) for the certificate to actually fire on typical noise;
+    lower floors remain correct but uncertifiable — at T = 2^20 the
+    reference's ``snr > 6`` floor (``clean.py:349``) is a mere 0.5
+    above the noise max, and pinning down exactness that close to the
+    noise genuinely costs a full sweep.
 
     ``capture_plane`` returns the *coarse* (FDMT) plane: the plane is a
     diagnostics product and the tree rows agree with the exact series up
@@ -638,6 +805,11 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
     dmmax = float(np.max(trial_dms))
 
     use_fused = jax.default_backend() == "tpu"
+    # (the pad-free soundness guard — disabling certificate + cert-proof
+    # on zero-padded TPU time axes — lives in hybrid_certificate_gate;
+    # the streaming driver sizes chunks so the post-resample axis is a
+    # tile multiple precisely so it never triggers there, and 50%
+    # overlap re-contains edge pulses in the neighbouring chunk)
     if use_fused:
         import jax.numpy as jnp
 
@@ -661,10 +833,16 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
     plane = None
     # the fused program earns its keep on wide sweeps; narrow grids
     # (fewer trials than the seed bucket) take the two-stage path, which
-    # also avoids top_k k > ndm edge cases
+    # also avoids top_k k > ndm edge cases.  With a detection floor set
+    # (streaming mode) the two-stage path is preferred even on TPU: a
+    # noise-certified chunk then pays ONE coarse dispatch and readback —
+    # the fused program would burn a full seed-bucket exact rescore on
+    # every chunk the certificate is about to skip (the survey majority),
+    # while a non-certified chunk only pays one extra ~0.1 s round trip.
     fused_seed = (use_fused and not capture_plane
                   and ndm >= 3 * HYBRID_SEED_TOPK
-                  and _pick_fdmt_tile(nsamples) > 0)
+                  and _pick_fdmt_tile(nsamples) > 0
+                  and (snr_floor is None or not noise_certificate))
     if fused_seed:
         # 1+2 fused: coarse sweep, device-side top-k seed selection and
         # exact seed rescore in ONE dispatch + ONE packed readback (each
@@ -681,18 +859,20 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
                                          rebased_full.shape)
         packed = np.asarray(kernel(data32, jnp.asarray(idx.astype(np.int32)),
                                    offs_dev))
-        coarse = packed[:5 * ndm].reshape(5, ndm).astype(np.float64)
-        sel = np.rint(packed[5 * ndm:5 * ndm + bucket]).astype(np.int64)
-        seed_scores = packed[5 * ndm + bucket:].reshape(5, bucket)
+        coarse = packed[:6 * ndm].reshape(6, ndm).astype(np.float64)
+        sel = np.rint(packed[6 * ndm:6 * ndm + bucket]).astype(np.int64)
+        seed_scores = packed[6 * ndm + bucket:].reshape(5, bucket)
         maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]
         windows = np.rint(coarse[3]).astype(np.int32)
         peaks = np.rint(coarse[4]).astype(np.int64)
+        cert_scores = coarse[5]
     else:
         # two-stage path (CPU, plane capture, or awkward time axes):
         # coarse sweep first, scores mapped host-side
-        (_, c_max, c_std, c_snr, c_win, c_peak, plane) = _search_jax_fdmt(
+        (_, c_max, c_std, c_snr, c_win, c_peak, plane,
+         c_cert) = _search_jax_fdmt(
             data, dmmin, dmmax, start_freq, bandwidth, sample_time,
-            capture_plane)
+            capture_plane, with_cert=True)
         if plane is not None and plane.shape[0] != ndm:
             # align the coarse plane with the plan grid (row gather —
             # cheap, and row-major on TPU unlike the scalarising lane
@@ -703,6 +883,7 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         snrs = np.asarray(c_snr, np.float64)[idx]
         windows = np.asarray(c_win, np.int32)[idx]
         peaks = np.asarray(c_peak, np.int64)[idx]
+        cert_scores = np.asarray(c_cert, np.float64)[idx]
 
     coarse_snrs = snrs.copy()
     exact = np.zeros(ndm, dtype=bool)
@@ -750,28 +931,26 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
     # costing a boxcar-scored pulse at most ~1/sqrt(3) of its S/N).
     if fused_seed:
         # the device already rescored the top-k neighbourhood: unpack it
+        # (kept even when certified — the scores are already computed and
+        # exact rows are strictly more informative)
         m, s, b_, w, p = (seed_scores[i].astype(np.float64)
                           for i in range(5))
         w = np.rint(w).astype(np.int32)
         p = (np.rint(p).astype(np.int64) - roll_k) % nsamples
         _apply(sel, (m, s, b_, w, p))
-        if snr_floor is not None:
-            # same +/-1 neighbour growth as the two-stage seed, so the
-            # "all above-threshold detections exact" contract is
-            # platform-independent
-            extra = np.flatnonzero(coarse_snrs >= snr_floor - 0.75)
-            if extra.size:
-                near = np.unique(np.clip(
-                    extra[:, None] + np.arange(-1, 2)[None, :], 0,
-                    ndm - 1))
-                todo = near[~exact[near]]
-                if todo.size:
-                    rescore(todo)
-    hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
-                          snr_floor=snr_floor, seed_done=fused_seed)
-    logger.debug("hybrid: %d/%d rows rescored exactly", exact.sum(), ndm)
+    # the rigorous cert-based criterion covers the snr_floor rows
+    # directly (every row that could hold an above-floor detection is
+    # flagged per-row), so no separate floor pre-pass is needed
+    certified, rho_cert_min = hybrid_certificate_gate(
+        cert_scores, coarse_snrs, snrs, exact, rescore, nchan=nchan,
+        trial_dms=trial_dms, start_freq=start_freq, bandwidth=bandwidth,
+        sample_time=sample_time, nsamples=nsamples, snr_floor=snr_floor,
+        noise_certificate=noise_certificate, seed_done=fused_seed)
+    logger.debug("hybrid: %d/%d rows rescored exactly%s", exact.sum(), ndm,
+                 " (noise-certified)" if certified else "")
 
-    return maxvalues, stds, snrs, windows, peaks, exact, plane
+    return (maxvalues, stds, snrs, windows, peaks, exact, plane,
+            cert_scores, certified, rho_cert_min)
 
 
 # ---------------------------------------------------------------------------
@@ -781,7 +960,8 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
 def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                         show=False, *, backend="numpy", capture_plane=None,
                         trial_dms=None, dm_block=None, chan_block=None,
-                        dtype=None, kernel="auto", snr_floor=None):
+                        dtype=None, kernel="auto", snr_floor=None,
+                        noise_certificate=True):
     """Sweep trial DMs over ``data`` and score each dedispersed series.
 
     Parameters mirror the reference façade
@@ -798,10 +978,14 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         (one trial per integer sample of band-crossing delay).
     dm_block, chan_block : JAX blocking factors (memory/speed trade-off).
     dtype : device dtype for the JAX path (default float32).
-    snr_floor : ``kernel="hybrid"`` only — when set, every row whose
-        coarse S/N reaches ``snr_floor - 0.75`` is exactly rescored too
-        (all above-threshold detections exact, not just the best); see
-        :func:`_search_jax_hybrid` for when this is affordable.
+    snr_floor : ``kernel="hybrid"`` only — when set, every row that
+        could hold an above-floor detection is exactly rescored (all
+        above-threshold detections exact, not just the best), and the
+        noise certificate becomes available; see
+        :func:`_search_jax_hybrid`.
+    noise_certificate : ``kernel="hybrid"`` with ``snr_floor`` only —
+        allow the certified fast path on signal-free chunks (default
+        on); the verdict lands in ``table.meta["certified"]``.
     kernel : JAX-path kernel selector: ``"auto"`` (Pallas on TPU, gather
         elsewhere), ``"pallas"`` (hand-written tiled TPU kernel, see
         :mod:`.pallas_dedisperse`), ``"gather"`` (portable XLA
@@ -870,9 +1054,12 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         if dtype not in (None, _jnp.float32):
             raise ValueError("kernel='hybrid' supports float32 only")
         (maxvalues, stds, best_snrs, best_windows, best_peaks, exact,
-         plane) = _search_jax_hybrid(data, trial_dms, start_freq, bandwidth,
-                                     sample_time, capture_plane, dm_block,
-                                     chan_block, snr_floor=snr_floor)
+         plane, cert_scores, certified,
+         rho_cert) = _search_jax_hybrid(data, trial_dms, start_freq,
+                                        bandwidth, sample_time,
+                                        capture_plane, dm_block,
+                                        chan_block, snr_floor=snr_floor,
+                                        noise_certificate=noise_certificate)
         table = ResultTable({
             "DM": trial_dms,
             "max": maxvalues,
@@ -881,7 +1068,9 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
             "rebin": best_windows,
             "peak": best_peaks,
             "exact": exact,
-        })
+            "cert": cert_scores,
+        }, meta={"certified": certified, "rho_cert": rho_cert,
+                 "snr_floor": snr_floor})
         return (table, plane) if (capture_plane or show) else table
 
     if backend == "numpy":

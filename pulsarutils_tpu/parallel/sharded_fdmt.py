@@ -107,7 +107,8 @@ def _stacked_tables(plans, t_tile):
 
 @functools.lru_cache(maxsize=8)
 def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
-                        use_pallas, interpret, plan_key, t_orig):
+                        use_pallas, interpret, plan_key, t_orig,
+                        with_cert=False):
     """Compile the SPMD transform+score program for one mesh/geometry.
 
     ``plan_key`` carries the static per-iteration bounds (k_tiles,
@@ -145,7 +146,8 @@ def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
         if t_orig != t:
             state = state[:, :t_orig]
         # score every (padded) row; junk rows are dropped host-side
-        return score_profiles_chunked(state, jnp)[None]  # (1, 5, rows)
+        return score_profiles_chunked(state, jnp,
+                                      with_cert=with_cert)[None]
 
     in_specs = [P()] + [P(axis)] * (4 * len(iter_meta))
     fn = jax.jit(jax.shard_map(
@@ -158,7 +160,8 @@ def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
 
 
 def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
-                        sample_time, mesh, axis="dm", use_pallas=None):
+                        sample_time, mesh, axis="dm", use_pallas=None,
+                        with_cert=False):
     """FDMT sweep with the trial-DM axis sharded over ``mesh[axis]``.
 
     Same scientific contract as ``dedispersion_search(kernel="fdmt")``
@@ -208,7 +211,7 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
 
     fn = _build_sharded_fdmt(mesh, axis, nchan, plans[0].nchan_padded,
                              t_run, t_tile, use_pallas, interpret,
-                             plan_key, t)
+                             plan_key, t, with_cert)
     flat = []
     for it in tables:
         flat += [jnp.asarray(it[k]) for k in
@@ -219,22 +222,26 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     # its delay slice; the rest is padding junk
     cols = []
     for d, (lo, hi) in enumerate(slices):
-        stacked = out[d]  # (5, rows_max_final)
+        stacked = out[d]  # (5|6, rows_max_final)
         cols.append(stacked[:, :hi - lo + 1])
-    maxvalues, stds, snrs, wins, peaks = unstack_scores(
-        np.concatenate(cols, axis=1))
-    return ResultTable({
+    scores = unstack_scores(np.concatenate(cols, axis=1))
+    maxvalues, stds, snrs, wins, peaks = scores[:5]
+    columns = {
         "DM": trial_dms,
         "max": maxvalues,
         "std": stds,
         "snr": snrs,
         "rebin": wins,
         "peak": peaks,
-    })
+    }
+    if with_cert:
+        columns["cert"] = scores[5]
+    return ResultTable(columns)
 
 
 def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
-                          sample_time, mesh, snr_floor=None):
+                          sample_time, mesh, snr_floor=None,
+                          noise_certificate=True):
     """Hybrid (exact hits at coarse cost) over a ``(dm, chan)`` mesh.
 
     Multi-device composition of ``dedispersion_search(kernel="hybrid")``:
@@ -242,28 +249,34 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     idle/replicated there — use ``chan=1`` meshes when the coarse stage
     dominates), and the exact rescore of candidate rows runs through
     :func:`~pulsarutils_tpu.parallel.sharded.sharded_dedispersion_search`
-    over the full mesh.  The guarantee loop (one-sided margin + coarse-
-    trust bound) is shared with the single-device hybrid, so the hit-
-    detection contract is identical: the returned argbest row holds the
-    exact kernel's scores, with an ``exact`` column marking exact rows.
+    over the full mesh.  The guarantee loop, the rigorous cert-based
+    skip proof and the noise certificate are shared with the
+    single-device hybrid (:mod:`~pulsarutils_tpu.ops.certify`), so the
+    contract is identical: the returned argbest row holds the exact
+    kernel's scores (unless ``meta["certified"]``, which asserts no
+    detection above ``snr_floor`` exists), with an ``exact`` column
+    marking exact rows.
     """
     import jax.numpy as jnp
 
     from ..ops.plan import dedispersion_plan
     from ..ops.search import (
-        hybrid_guarantee_loop,
+        hybrid_certificate_gate,
         iter_rescore_buckets,
         nearest_rows,
     )
     from .sharded import sharded_dedispersion_search
 
-    nchan = np.shape(data)[0]
+    nchan, nsamples = np.shape(data)
+    # (the pad-free soundness guard lives in hybrid_certificate_gate,
+    # shared verbatim with the single-device hybrid)
     # ONE host->device transfer: the coarse stage and every rescore call
     # reuse the same device-resident array (sharded_dedispersion_search
     # passes aligned device inputs through untouched)
     data = jnp.asarray(data, jnp.float32)
     t_coarse = sharded_fdmt_search(data, dmmin, dmmax, start_freq,
-                                   bandwidth, sample_time, mesh, axis="dm")
+                                   bandwidth, sample_time, mesh, axis="dm",
+                                   with_cert=True)
     trial_dms = np.asarray(dedispersion_plan(
         nchan, dmmin, dmmax, start_freq, bandwidth, sample_time),
         dtype=np.float64)
@@ -275,6 +288,7 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     snrs = np.asarray(t_coarse["snr"], np.float64)[idx]
     windows = np.asarray(t_coarse["rebin"], np.int32)[idx]
     peaks = np.asarray(t_coarse["peak"], np.int64)[idx]
+    cert_scores = np.asarray(t_coarse["cert"], np.float64)[idx]
     coarse_snrs = snrs.copy()
     exact = np.zeros(ndm, dtype=bool)
 
@@ -291,8 +305,11 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
             peaks[blk] = np.asarray(t_ex["peak"])[:k]
             exact[blk] = True
 
-    hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
-                          snr_floor=snr_floor)
+    certified, rho_cert_min = hybrid_certificate_gate(
+        cert_scores, coarse_snrs, snrs, exact, rescore, nchan=nchan,
+        trial_dms=trial_dms, start_freq=start_freq, bandwidth=bandwidth,
+        sample_time=sample_time, nsamples=nsamples, snr_floor=snr_floor,
+        noise_certificate=noise_certificate)
     return ResultTable({
         "DM": trial_dms,
         "max": maxvalues,
@@ -301,4 +318,6 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         "rebin": windows,
         "peak": peaks,
         "exact": exact,
-    })
+        "cert": cert_scores,
+    }, meta={"certified": certified, "rho_cert": rho_cert_min,
+             "snr_floor": snr_floor})

@@ -59,6 +59,17 @@ def plan_chunks(nsamples, sample_time, dmmin, dmmax, start_freq, stop_freq,
         new_sample_time = max(dm_dt / 10, sample_time)
     ratio = new_sample_time / sample_time
     resample = int(np.rint(ratio)) if ratio >= 2 else 1
+
+    if step >= 1024 * resample:
+        # round the chunk up so the POST-RESAMPLE time axis is a
+        # multiple of the FDMT/Pallas tile size: a non-tile-divisible
+        # searched axis forces the TPU transform to zero-pad (slower,
+        # and it disables the hybrid's noise certificate — the pad
+        # breaks the circular-gather model its soundness bound
+        # assumes).  A slightly larger chunk keeps the physics
+        # guarantee (chunk >= 2x the band-crossing delay).
+        quantum = 1024 * resample
+        step = -(-step // quantum) * quantum
     return ChunkPlan(step=step, hop=step // 2, resample=resample,
                      sample_time=resample * sample_time)
 

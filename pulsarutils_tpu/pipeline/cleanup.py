@@ -31,16 +31,33 @@ def cleanup_data(fname, outname, surelybad=(), fft_zap=False,
     raw_header, _ = read_header(fname)
     raw_header.setdefault("nbits", reader.header.get("nbits", 32))
 
+    # multi-IF files are cleaned PER IF PLANE and written back
+    # interleaved (same nifs header): the bad-channel mask comes from
+    # the IF-summed bandpass (one mask for all planes, the standard
+    # convention), but zeroing/zapping must touch each plane's own data
+    # — writing the IF sum under a multi-IF header would corrupt the
+    # file's layout
+    nifs = reader.nifs
+    if_readers = ([reader] if nifs == 1 else
+                  [FilterbankReader(fname, if_mode=k) for k in range(nifs)])
+
+    def clean_block(block):
+        nonlocal nzapped
+        block = block.copy()
+        block[mask, :] = 0.0
+        if fft_zap:
+            block, zapped = fft_zap_time(block)
+            block[mask, :] = 0.0  # irfft reintroduces tiny leakage
+            nzapped += int(np.asarray(zapped).sum())
+        return block
+
     nzapped = 0
     with FilterbankWriter(outname, raw_header) as writer:
-        for istart, block in reader.iter_blocks(chunksize):
-            block = block.copy()
-            block[mask, :] = 0.0
-            if fft_zap:
-                block, zapped = fft_zap_time(block)
-                block[mask, :] = 0.0  # irfft reintroduces tiny leakage
-                nzapped += int(np.asarray(zapped).sum())
-            writer.write_block(block)
+        for istart in range(0, reader.nsamples, chunksize):
+            planes = [clean_block(r.read_block(istart, chunksize))
+                      for r in if_readers]
+            writer.write_block(planes[0] if nifs == 1
+                               else np.stack(planes))
     logger.info("cleaned %s -> %s (%d bad channels%s)", fname, outname,
                 int(mask.sum()),
                 f", {nzapped} Fourier bins zapped" if fft_zap else "")

@@ -37,7 +37,7 @@ from ..utils.logging_utils import StageTimer, logger
 
 def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
                           eff_tsamp, *, backend, kernel, capture_plane,
-                          state=None):
+                          state=None, mesh=None, snr_floor=None):
     """One chunk's search with failure containment.
 
     The reference has no failure handling at all (SURVEY §5).  Policy:
@@ -46,12 +46,20 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
       they are deterministic and would fail identically on every chunk;
     - a device-side failure (worker crash, wedged tunnel, OOM) is retried
       once on the same backend, then the chunk falls back to the NumPy
-      reference path;
+      reference path (a ``mesh`` run falls back the same way: the mesh
+      route is dropped along with the jax backend);
     - the fallback decision is remembered in ``state`` (a mutable dict
       shared across the chunk loop), so a persistently broken device is
       discovered once — not re-discovered with two doomed attempts per
       chunk — and every subsequent chunk runs on the same backend/kernel
       (one consistent trial grid in the candidate store).
+
+    ``mesh`` routes the chunk through the sharded multi-device searches
+    (``kernel="hybrid"`` -> :func:`..parallel.sharded_fdmt.sharded_hybrid_search`,
+    ``"fdmt"`` -> :func:`..parallel.sharded_fdmt.sharded_fdmt_search`,
+    anything else -> the DM x chan sharded exact sweep).  ``snr_floor``
+    reaches the hybrid searches (single- and multi-device) so the noise
+    certificate can fire on signal-free chunks.
     """
     state = state if state is not None else {}
     bk = state.get("backend", backend)
@@ -60,11 +68,39 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
     if bk != "numpy":
         attempts.append(("numpy", "auto"))
     last = None
+
+    def run_one(b, k):
+        if mesh is not None and b == "jax":
+            if capture_plane:
+                raise ValueError(
+                    "mesh streaming does not capture the dedispersed "
+                    "plane; disable make_plots/period_search or drop "
+                    "mesh=")
+            from ..parallel.sharded import sharded_dedispersion_search
+            from ..parallel.sharded_fdmt import (
+                sharded_fdmt_search,
+                sharded_hybrid_search,
+            )
+
+            if k == "hybrid":
+                return sharded_hybrid_search(
+                    array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
+                    mesh=mesh, snr_floor=snr_floor)
+            if k == "fdmt":
+                return sharded_fdmt_search(
+                    array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
+                    mesh=mesh)
+            return sharded_dedispersion_search(
+                array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
+                mesh=mesh)
+        return dedispersion_search(
+            array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
+            backend=b, kernel=k, capture_plane=capture_plane,
+            **({"snr_floor": snr_floor} if k == "hybrid" else {}))
+
     for i, (b, k) in enumerate(attempts):
         try:
-            result = dedispersion_search(
-                array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
-                backend=b, kernel=k, capture_plane=capture_plane)
+            result = run_one(b, k)
             if (b, k) != (bk, kern):
                 logger.error(
                     "device search failed persistently; the rest of this "
@@ -90,12 +126,48 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      make_plots="hits", resume=True, fft_zap=False,
                      cut_outliers=False, zero_dm=False, max_chunks=None,
                      progress=True, period_search=False,
-                     period_sigma_threshold=8.0, show_plots=False):
+                     period_sigma_threshold=8.0, show_plots=False,
+                     mesh=None, exact_floor="auto"):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
     TPU-framework knobs (keyword-only).  ``make_plots``: ``"hits"``
     (diagnostic JPEG per candidate), ``"all"``, or ``False``.
+
+    ``snr_threshold`` is the reference's hit criterion (``snr > 6``,
+    ``clean.py:349``).  Besides a number it accepts two strings that
+    adapt the floor to the chunk geometry (the fixed 6 was tuned for the
+    reference's ~1e3-sample chunks; at million-sample chunks the
+    signal-free maximum alone is ~5.5 — see :mod:`..ops.certify`):
+
+    * ``"auto"`` — the statistically matched floor
+      (:func:`~pulsarutils_tpu.ops.certify.matched_snr_floor`): noise
+      ceiling + 1, sub-percent false alarms per chunk;
+    * ``"certifiable"`` — the lowest floor whose noise certificate fires
+      on typical signal-free chunks
+      (:func:`~pulsarutils_tpu.ops.certify.certifiable_snr_floor`):
+      with ``kernel="hybrid"`` the streaming cost of a signal-free chunk
+      drops to one coarse sweep (the survey fast path).
+
+    ``exact_floor`` controls whether ``snr_threshold`` is also forwarded
+    as the hybrid kernel's ``snr_floor`` (the all-above-threshold-
+    detections-exact contract + the noise certificate):
+
+    * ``"auto"`` (default) — forwarded only when the threshold sits at
+      or above the chunk geometry's certifiable floor; below it the
+      hybrid runs floorless (exact best row only — the fast behaviour
+      the fixed reference thresholds historically got), with an
+      info-level log stating so;
+    * ``True`` — always forwarded: every above-threshold detection is
+      exact, accepting that below the certifiable floor this honestly
+      costs up to a full exact sweep per chunk;
+    * ``False`` — never forwarded.
+
+    ``mesh`` (a ``jax.sharding.Mesh``) routes every chunk through the
+    multi-device sharded searches — the same device-resident chunk is
+    searched by all devices (DM-sliced coarse stage + sharded exact
+    rescore for ``kernel="hybrid"``); plane capture (``make_plots`` /
+    ``period_search``) is not available on the mesh path.
 
     ``show_plots=True`` additionally displays each diagnostic figure in
     an interactive window (the reference's ``show=True`` behaviour,
@@ -112,6 +184,13 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     Returns ``(hits, store)`` where hits is a list of
     ``(istart, iend, PulseInfo, ResultTable)``.
     """
+    # identity checks on purpose: exact_floor=1 must NOT silently pass
+    # as True (the floor-forwarding branches use `is True`/`is not
+    # False`); validated before any file IO so config errors fail fast
+    if exact_floor is not True and exact_floor is not False \
+            and exact_floor != "auto":
+        raise ValueError(f"exact_floor={exact_floor!r}: expected True, "
+                         "False or 'auto'")
     logger.info("opening %s", fname)
     # strip only the final extension: "obs.day1.fil" and "obs.day2.fil"
     # must keep distinct candidate roots in a shared output directory
@@ -152,6 +231,72 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     logger.info("chunk plan: step=%d hop=%d resample=%d -> tsamp=%g s",
                 plan.step, plan.hop, plan.resample, eff_tsamp)
 
+    def _chunk_cert_floor():
+        """Certifiable floor for this chunk geometry (lazy: the
+        retention bound is a multi-second host computation at
+        multi-thousand-trial configs and only two configurations need
+        it — snr_threshold='certifiable', and the hybrid's
+        exact_floor='auto' comparison)."""
+        from ..ops.certify import certifiable_snr_floor, retention_bound
+        from ..ops.plan import dedispersion_plan
+
+        nchan = header["nchans"]
+        t_eff = max(plan.step // plan.resample, 2)
+        trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
+                                      bandwidth, eff_tsamp)
+        rho = retention_bound(nchan, trial_dms, start_freq, bandwidth,
+                              eff_tsamp, t_eff, cert=True)
+        return certifiable_snr_floor(t_eff, len(trial_dms), rho)
+
+    if isinstance(snr_threshold, str):
+        from ..ops.certify import matched_snr_floor
+        from ..ops.plan import dedispersion_plan
+
+        t_eff = max(plan.step // plan.resample, 2)
+        if snr_threshold == "auto":
+            ndm = len(dedispersion_plan(header["nchans"], dmmin, dmmax,
+                                        start_freq, bandwidth, eff_tsamp))
+            snr_threshold = matched_snr_floor(t_eff, ndm)
+        elif snr_threshold == "certifiable":
+            snr_threshold = _chunk_cert_floor()
+        else:
+            raise ValueError(
+                f"snr_threshold={snr_threshold!r}: expected a number, "
+                "'auto' or 'certifiable'")
+        snr_threshold = round(float(snr_threshold), 2)
+        logger.info("snr_threshold resolved to %.2f for %d-sample chunks",
+                    snr_threshold, t_eff)
+
+    # the hybrid gets the threshold as its snr_floor ONLY when the noise
+    # certificate can actually fire at that level: forwarding a
+    # sub-certifiable floor (e.g. the reference default 6.0 on
+    # million-sample chunks) would make the rigorous all-detections-exact
+    # criterion rescan toward a full exact sweep on EVERY chunk — the
+    # round-2 behaviour this round removed.  Below the certifiable level
+    # the hybrid runs floorless (exact-argbest-only contract, the round-2
+    # streaming semantics), which is both faster and what the fixed
+    # thresholds historically meant.
+    search_snr_floor = None
+    if kernel == "hybrid" and exact_floor is not False:
+        cert_floor = None if exact_floor is True else _chunk_cert_floor()
+        if exact_floor is True \
+                or snr_threshold >= round(cert_floor, 2) - 1e-9:
+            search_snr_floor = snr_threshold
+        else:
+            logger.info(
+                "snr_threshold %.2f sits below the certifiable floor "
+                "%.2f for this chunk geometry: hybrid runs without "
+                "snr_floor (exact best row only; pass exact_floor=True "
+                "to force the all-detections-exact contract, or "
+                "snr_threshold='certifiable' for the noise-certificate "
+                "fast path)", snr_threshold, cert_floor)
+
+    if mesh is not None and (make_plots or period_search):
+        raise ValueError("mesh streaming does not capture the dedispersed "
+                         "plane: pass make_plots=False and "
+                         "period_search=False (diagnostics need the "
+                         "single-device path)")
+
     fingerprint = config_fingerprint(
         fname=os.path.abspath(str(fname)), dmmin=dmmin, dmmax=dmmax,
         step=plan.step, resample=plan.resample, backend=backend,
@@ -161,6 +306,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         # key unconditionally would orphan every pre-existing resume
         # ledger for plain runs
         **({"zero_dm": True} if zero_dm else {}),
+        # same orphan-avoidance rule for the mesh route (device count
+        # changes the f32 reduction shapes, not the science)
+        **({"mesh": list(mesh.shape.values())} if mesh is not None else {}),
         surelybad=sorted(int(c) for c in surelybad),
         period_search=bool(period_search),
         period_sigma_threshold=float(period_sigma_threshold))
@@ -168,6 +316,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
 
     hits = []
     nproc = 0
+    ncertified = 0
     capture = bool(make_plots) or bool(period_search)
     fallback_state = {}
 
@@ -285,11 +434,17 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 result = _search_with_fallback(
                     array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
                     backend=backend, kernel=kernel, capture_plane=capture,
-                    state=fallback_state)
+                    state=fallback_state, mesh=mesh,
+                    snr_floor=search_snr_floor)
             table, plane = result if capture else (result, None)
 
             best = table.best_row()
             is_hit = bool(best["snr"] > snr_threshold)
+            if getattr(table, "meta", {}).get("certified"):
+                # hybrid noise certificate: the chunk provably holds no
+                # detection above snr_threshold (so is_hit is False by
+                # construction) and no exact rescoring was paid
+                ncertified += 1
 
             if period_search and plane is not None:
                 from ..ops.periodicity import period_search_plane
@@ -365,5 +520,6 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         raise
     reader_pool.shutdown(wait=True)
     timer.report()
-    logger.info("done: %d chunks processed, %d hits", nproc, len(hits))
+    logger.info("done: %d chunks processed, %d hits, %d noise-certified",
+                nproc, len(hits), ncertified)
     return hits, store

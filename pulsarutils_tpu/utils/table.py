@@ -15,10 +15,16 @@ import numpy as np
 
 
 class ResultTable(Mapping):
-    """Ordered mapping of column name -> 1-D numpy array (equal lengths)."""
+    """Ordered mapping of column name -> 1-D numpy array (equal lengths).
 
-    def __init__(self, columns):
+    ``meta`` is a free-form dict for per-table annotations that are not
+    columns (e.g. the hybrid's noise-certificate verdict); it is NOT
+    persisted by :meth:`to_npz`.
+    """
+
+    def __init__(self, columns, meta=None):
         self._cols = {}
+        self.meta = dict(meta) if meta else {}
         n = None
         for name, values in dict(columns).items():
             arr = np.asarray(values)

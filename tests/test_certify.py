@@ -1,0 +1,261 @@
+"""Certificate & retention-bound tests: the hybrid's soundness machinery.
+
+Covers VERDICT r2 items #1 (noise-certificate fast path semantics) and #4
+(adversarial validation of the hybrid guarantee + measured calibration of
+the coarse-trust bound).  The larger seeded sweep lives in
+``tools/hybrid_calibrate.py``; the cases here are its CI-sized core.
+"""
+
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.ops.certify import (
+    HYBRID_CERT_SLACK,
+    cert_retention,
+    certifiable_snr_floor,
+    certify_noise_only,
+    coarse_retention,
+    expected_noise_max_snr,
+)
+from pulsarutils_tpu.ops.fdmt import (
+    fdmt_plan,
+    fdmt_tracks,
+    fdmt_transform,
+    fdmt_trial_dms,
+)
+from pulsarutils_tpu.ops.plan import (
+    dedispersion_plan,
+    dedispersion_shifts,
+)
+from pulsarutils_tpu.ops.search import dedispersion_search, nearest_rows
+
+GEOM = dict(start_freq=1200.0, bandwidth=200.0, sample_time=0.0005)
+GARGS = (GEOM["start_freq"], GEOM["bandwidth"], GEOM["sample_time"])
+
+
+def make_noise(nchan, nsamples, seed):
+    rng = np.random.default_rng(seed)
+    return (np.abs(rng.standard_normal((nchan, nsamples))) * 0.5).astype(
+        np.float32)
+
+
+def inject_pulse(array, dm, amp, width=1, pos=None, geom=GARGS):
+    """Boxcar pulse of ``width`` samples per channel along the exact
+    integer dispersion track at ``dm``."""
+    nchan, t = array.shape
+    out = array.copy()
+    pos = t // 2 if pos is None else pos
+    shifts = np.rint(np.asarray(dedispersion_shifts(
+        nchan, dm, *geom))).astype(int)
+    for c in range(nchan):
+        for k in range(width):
+            out[c, (pos + k + shifts[c]) % t] += amp / width
+    return out
+
+
+class TestTracks:
+    def test_tracks_reproduce_transform(self):
+        """fdmt_tracks must describe EXACTLY what the transform computes."""
+        nchan, t, lo, hi = 32, 512, 10, 40
+        plan = fdmt_plan(nchan, *GARGS[:2], hi, lo)
+        tracks = fdmt_tracks(plan)
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((nchan, t)).astype(np.float32)
+        out = np.asarray(fdmt_transform(data, hi, *GARGS[:2],
+                                        use_pallas=False, min_delay=lo))
+        tt = np.arange(t)
+        for r in range(tracks.shape[0]):
+            manual = sum(data[c, (tt + tracks[r, c]) % t]
+                         for c in range(nchan))
+            np.testing.assert_allclose(out[r], manual, rtol=1e-5, atol=1e-4)
+
+    def test_track_deviation_small(self):
+        """Tree tracks deviate from the exact integer tracks by at most a
+        few samples per channel (after removing the per-row anchoring
+        rotation) — the Zackay & Ofek deviation bound, now MEASURED."""
+        from pulsarutils_tpu.ops.certify import _track_deviations
+
+        nchan, t = 256, 1 << 14
+        dms = dedispersion_plan(nchan, 100.0, 200.0, *GARGS)
+        dev = _track_deviations(nchan, dms, *GARGS, t)
+        spread = dev.max(axis=1) - dev.min(axis=1)
+        assert spread.max() <= 4, f"track spread up to {spread.max()}"
+
+
+class TestRetention:
+    def test_bounds_sane_and_quoted(self):
+        """The computed bounds must stay in the range the docstrings
+        quote: block retention ~0.44+ (the corrected HYBRID_COARSE_TRUST
+        basis), cert retention ~0.55+ (the certificate basis)."""
+        nchan, t = 256, 1 << 14
+        dms = dedispersion_plan(nchan, 100.0, 200.0, *GARGS)
+        rho_b = coarse_retention(nchan, dms, *GARGS, t)
+        rho_c = cert_retention(nchan, dms, *GARGS, t)
+        assert 0.40 <= rho_b.min() <= 1.0
+        assert 0.50 <= rho_c.min() <= 1.0
+        # the sliding certificate scorer must beat the block scorer's
+        # worst case — that is its reason to exist
+        assert rho_c.min() > rho_b.min()
+
+    def test_wider_pulses_retain_more(self):
+        nchan, t = 128, 1 << 13
+        dms = dedispersion_plan(nchan, 100.0, 200.0, *GARGS)
+        r1 = coarse_retention(nchan, dms, *GARGS, t, min_width=1).min()
+        r4 = coarse_retention(nchan, dms, *GARGS, t, min_width=4).min()
+        assert r4 >= r1
+
+
+class TestNoiseCeiling:
+    def test_matches_simulation(self):
+        """The fitted Gumbel location must track the simulated cert-score
+        maxima (this is what certifiable_snr_floor rests on)."""
+        nchan, t = 128, 1 << 13
+        maxima = []
+        for seed in range(4):
+            noise = make_noise(nchan, t, seed)
+            tb = dedispersion_search(noise, 100.0, 200.0, *GARGS,
+                                     backend="jax", kernel="hybrid",
+                                     noise_certificate=False)
+            maxima.append(float(tb["cert"].max()))
+        est = expected_noise_max_snr(t, tb.nrows)
+        assert abs(np.mean(maxima) - est) < 0.5, (np.mean(maxima), est)
+
+
+class TestCertificateSemantics:
+    """Pin the noise certificate's contract (VERDICT r2 #1)."""
+
+    nchan, t = 128, 1 << 13
+
+    def _floor(self):
+        dms = dedispersion_plan(self.nchan, 100.0, 200.0, *GARGS)
+        rho = cert_retention(self.nchan, dms, *GARGS, self.t).min()
+        return certifiable_snr_floor(self.t, len(dms), rho)
+
+    def test_noise_certifies_with_zero_rescore(self):
+        floor = self._floor()
+        fired = 0
+        for seed in range(3):
+            tb = dedispersion_search(make_noise(self.nchan, self.t, seed),
+                                     100.0, 200.0, *GARGS, backend="jax",
+                                     kernel="hybrid", snr_floor=floor)
+            if tb.meta["certified"]:
+                fired += 1
+                # certified => nothing was rescored, and no false hit is
+                # possible (block snr <= sqrt(2) * cert < floor)
+                assert int(tb["exact"].sum()) == 0
+                assert tb.best_row()["snr"] < floor
+        assert fired >= 2, f"certificate fired on {fired}/3 noise chunks"
+
+    def test_pulse_above_floor_never_certifies(self):
+        floor = self._floor()
+        for seed, (width, dm) in enumerate(
+                [(1, 101.3), (1, 150.0), (2, 198.2), (4, 125.0),
+                 (8, 175.0), (1, 199.5)]):
+            noise = make_noise(self.nchan, self.t, 100 + seed)
+            # amplitude sized so the exact S/N clears the floor with
+            # margin; worst-phase positions exercised via the seed
+            sig = inject_pulse(noise, dm, amp=3.0, width=width,
+                               pos=self.t // 2 + seed)
+            tb = dedispersion_search(sig, 100.0, 200.0, *GARGS,
+                                     backend="jax", kernel="hybrid",
+                                     snr_floor=floor)
+            ref = dedispersion_search(sig, 100.0, 200.0, *GARGS,
+                                      backend="numpy")
+            assert ref.best_row()["snr"] > floor, "test setup: too weak"
+            assert not tb.meta["certified"], (width, dm)
+            assert tb.argbest() == ref.argbest(), (width, dm)
+            assert bool(tb["exact"][tb.argbest()])
+
+    def test_certificate_opt_out(self):
+        tb = dedispersion_search(make_noise(self.nchan, self.t, 0),
+                                 100.0, 200.0, *GARGS, backend="jax",
+                                 kernel="hybrid", snr_floor=self._floor(),
+                                 noise_certificate=False)
+        assert tb.meta["certified"] is False
+
+    def test_no_floor_no_certificate(self):
+        tb = dedispersion_search(make_noise(self.nchan, self.t, 1),
+                                 100.0, 200.0, *GARGS, backend="jax",
+                                 kernel="hybrid")
+        assert tb.meta["certified"] is False
+
+
+class TestGuaranteeSweep:
+    """CI-sized adversarial sweep (VERDICT r2 #4): hybrid argbest must
+    equal the exact kernel's argbest across geometry x width x DM x
+    noise draws, including constructed worst cases (width-1 pulses at
+    band-edge DMs, all pulse phases mod 8); and the certificate
+    inequality ``cert >= rho * exact - SLACK`` must hold empirically.
+    The full sweep (hundreds of draws + the measured-bound report) is
+    ``tools/hybrid_calibrate.py``."""
+
+    def test_sweep(self):
+        rng = np.random.default_rng(7)
+        nchan, t = 128, 1 << 13
+        dms_grid = dedispersion_plan(nchan, 100.0, 200.0, *GARGS)
+        rho_c = cert_retention(nchan, dms_grid, *GARGS, t)
+        violations = []
+        underestimates = []
+        cases = []
+        # constructed worst cases: width-1 at band-edge DMs, all phases
+        for phase in range(8):
+            cases.append((1, 100.2 + 0.1 * phase, t // 2 + phase))
+            cases.append((1, 199.0 + 0.1 * phase, t // 3 + phase))
+        # random draws
+        for _ in range(24):
+            cases.append((int(rng.choice([1, 1, 2, 3, 4, 8])),
+                          float(rng.uniform(100.0, 200.0)),
+                          int(rng.integers(100, t - 100))))
+        for i, (width, dm, pos) in enumerate(cases):
+            noise = make_noise(nchan, t, 1000 + i)
+            sig = inject_pulse(noise, dm, amp=float(rng.uniform(2.0, 5.0)),
+                               width=width, pos=pos)
+            hyb = dedispersion_search(sig, 100.0, 200.0, *GARGS,
+                                      backend="jax", kernel="hybrid")
+            ref = dedispersion_search(sig, 100.0, 200.0, *GARGS,
+                                      backend="numpy")
+            j = ref.argbest()
+            assert hyb.argbest() == j, (width, dm, pos)
+            assert bool(hyb["exact"][hyb.argbest()])
+            s_ref = float(ref["snr"][j])
+            # certificate inequality at the best row
+            viol = rho_c[j] * s_ref - HYBRID_CERT_SLACK - float(
+                hyb["cert"][j])
+            violations.append(viol)
+            underestimates.append(1.0 - float(hyb["cert"][j]) / s_ref)
+        worst = max(violations)
+        assert worst <= 0.0, (
+            f"certificate inequality violated by {worst:.3f} "
+            "(raise HYBRID_CERT_SLACK)")
+        # observed cert-score underestimate stays inside the computed
+        # bound's regime (report-style guard; the full measured report is
+        # tools/hybrid_calibrate.py)
+        assert max(underestimates) <= 1.0 - rho_c.min() + 0.1
+
+
+class TestCertifyHelpers:
+    def test_certify_noise_only_logic(self):
+        assert not certify_noise_only(np.array([5.0]), None, 0.6)
+        assert certify_noise_only(np.array([3.0]), 10.0, 0.6)   # 3 < 5.5
+        assert not certify_noise_only(np.array([5.6]), 10.0, 0.6)
+        # block-S/N consistency guard: a chunk whose coarse block score
+        # already reaches the floor is never certified (non-impulsive
+        # junk outside the signal model)
+        assert not certify_noise_only(np.array([3.0]), 10.0, 0.6,
+                                      coarse_snrs=np.array([12.0]))
+        assert certify_noise_only(np.array([3.0]), 10.0, 0.6,
+                                  coarse_snrs=np.array([5.0]))
+
+    def test_certifiable_floor_monotone(self):
+        a = certifiable_snr_floor(1 << 13, 128, 0.6)
+        b = certifiable_snr_floor(1 << 20, 512, 0.6)
+        assert b > a > 5.0
+
+    def test_cert_windows_shared_constant(self):
+        """SOUNDNESS COUPLING: the device scorer structurally unrolls
+        these widths and the retention bound iterates the same constant;
+        the guarantee sweep would catch semantic drift, this pins the
+        declared set."""
+        from pulsarutils_tpu.ops.search import CERT_WINDOWS
+
+        assert CERT_WINDOWS == (2, 3, 4)

