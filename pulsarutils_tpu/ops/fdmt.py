@@ -514,10 +514,40 @@ def _merge_pallas(state, it, t_tile, interpret):
     return out[:rows_out] if pad else out
 
 
+def _head_enabled(use_pallas):
+    """Resolve the fused-head knob (PUTPU_FDMT_HEAD: ''=auto, 0, 1).
+
+    Resolved at the call sites (not inside the cached transform
+    builders) so the choice is part of the compile-cache key.
+
+    Default ON for TPU (measured, v5e, 1024 x 1M benchmark): the head
+    is bit-identical, cuts the covered levels' HBM traffic ~4x, and
+    with the 8-row-unrolled row loop measures 0.323 s vs 0.365 s for
+    the per-level path (transform+score).  The win needed two tuning
+    rounds — 128-lane chunks measured 0.62 s and an un-unrolled row
+    loop 0.53 s (both scalar/instruction-bound, see
+    ops/fdmt_resident.py) — so the knob stays for bisection.
+    """
+    knob = os.environ.get("PUTPU_FDMT_HEAD", "")
+    if knob == "0":
+        return False
+    if knob == "1":
+        return True
+    if knob:
+        import warnings
+
+        # a silently-ignored 'off'/'true' would make an A/B bisection
+        # measure the same program twice (mirrors _merge_row_block)
+        warnings.warn(f"PUTPU_FDMT_HEAD={knob!r} ignored (expected '0' "
+                      "or '1'); using the platform default", stacklevel=2)
+    return bool(use_pallas)
+
+
 @functools.lru_cache(maxsize=16)
 def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                   use_pallas, interpret, n_lo=0, with_scores=False,
-                  with_plane=True, t_orig=None, with_cert=False):
+                  with_plane=True, t_orig=None, with_cert=False,
+                  use_head=False):
     """The traceable (un-jitted) transform body: DM-pruned merges
     [+ scoring].  :func:`_build_transform` wraps it in ``jax.jit``;
     the hybrid search composes it with its fused seed-rescore program
@@ -534,13 +564,43 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
 
     plan = fdmt_plan(nchan, start_freq, bandwidth, max_delay, n_lo)
 
+    # VMEM-resident fused head (ops/fdmt_resident.py): the first
+    # HEAD_LEVELS merges — ~75% of the per-level HBM traffic — run in
+    # one Pallas program whose intermediate states never leave VMEM,
+    # bit-identical to the per-level path.  ``use_head`` is resolved by
+    # the caller via _head_enabled (auto on TPU; PUTPU_FDMT_HEAD
+    # overrides) so it keys the compile caches.
+    head_run = None
+    n_head = 0
+    if use_head:
+        from .fdmt_resident import (
+            HEAD_LEVELS,
+            HEAD_T_SLICE,
+            _build_head_kernel,
+            _head_plan_cached,
+            head_supported,
+        )
+
+        if head_supported(plan.nchan_padded, len(plan.iterations), t):
+            hp = _head_plan_cached(nchan, start_freq, bandwidth,
+                                   max_delay, n_lo, HEAD_LEVELS)
+            if head_supported(plan.nchan_padded, len(plan.iterations), t,
+                              halo=hp.halo,
+                              max_level_shift=max(hp.max_shift_per_level)):
+                head_run, _ = _build_head_kernel(
+                    nchan, start_freq, bandwidth, max_delay, n_lo,
+                    HEAD_LEVELS, t, HEAD_T_SLICE, interpret)
+                n_head = HEAD_LEVELS
+
     def fn(data):
         state = data
         if nchan < plan.nchan_padded:
             state = jnp.concatenate(
                 [state,
                  jnp.zeros((plan.nchan_padded - nchan, t), state.dtype)])
-        for it in plan.iterations:
+        if head_run is not None:
+            state = head_run(state)
+        for it in plan.iterations[n_head:]:
             if use_pallas:
                 state = _merge_pallas(state, it, t_tile, interpret)
             else:
@@ -569,7 +629,8 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
 @functools.lru_cache(maxsize=16)
 def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                      use_pallas, interpret, n_lo=0, with_scores=False,
-                     with_plane=True, t_orig=None, with_cert=False):
+                     with_plane=True, t_orig=None, with_cert=False,
+                     use_head=False):
     """Jitted wrapper of :func:`_transform_fn` (same signature)."""
     import jax
 
@@ -577,7 +638,7 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                                  t, t_tile, use_pallas, interpret,
                                  n_lo=n_lo, with_scores=with_scores,
                                  with_plane=with_plane, t_orig=t_orig,
-                                 with_cert=with_cert))
+                                 with_cert=with_cert, use_head=use_head))
 
 
 # ---------------------------------------------------------------------------
@@ -619,7 +680,8 @@ def fdmt_transform(data, max_delay, start_freq, bandwidth, use_pallas=None,
     # consumer has read it.
     run = _build_transform(nchan, float(start_freq), float(bandwidth),
                            int(max_delay), t_run, t_tile, use_pallas,
-                           interpret, n_lo=int(min_delay), t_orig=t_orig)
+                           interpret, n_lo=int(min_delay), t_orig=t_orig,
+                           use_head=_head_enabled(use_pallas))
     return run(data)
 
 

@@ -1,0 +1,371 @@
+"""VMEM-resident fused head for the FDMT: the first ~7 tree levels in
+ONE Pallas kernel, intermediate states never touching HBM.
+
+Why: the per-level merge kernel is HBM-bound — every tree level writes
+its full state and the next reads it back (plus halo), ~100 GB of
+traffic for the 1M-sample benchmark transform, measured at ~40% of the
+chip's bandwidth (``docs/performance.md`` round 2: 0.35 s vs the 0.15 s
+traffic bound).  The EARLY levels are 75% of that traffic (row counts
+shrink slowly: 1023, 767, 639, ... for the benchmark plan) *and* they
+are channel-local: level ``l`` only ever combines rows within
+``2^(l+1)``-channel bands.  So the first ``HEAD_LEVELS`` levels split
+into independent 128-channel groups whose whole sub-tree state
+(~260 live rows x a few-thousand-sample slice) fits VMEM:
+
+* grid = (channel groups, time slices);
+* each step stitches its input slice (+ the head's cumulative shift
+  halo) into a VMEM buffer, runs all head levels ping-pong between two
+  VMEM scratch buffers, and writes only the LAST head level's rows to
+  HBM — one read of the input + one write of the head output instead of
+  ~4 HBM passes per level;
+* per-row shifted reads reuse the aligned-load + lane-rotate + blend
+  primitive of the dedispersion kernel
+  (:func:`~pulsarutils_tpu.ops.pallas_dedisperse.shifted_row_tile`);
+  merge tables ride scalar prefetch exactly like the per-level kernel.
+
+The deep levels (large shifts, few rows) stay on the existing
+per-level kernel: their halos are too wide for VMEM residency and they
+carry only ~25% of the traffic.
+
+Numerics: the fused head performs the SAME adds in the SAME order as
+the per-level path (each level's partial sums are identical floats,
+merely held in VMEM) — outputs are bit-identical, pinned by
+``tests/test_fdmt_resident.py``.
+
+Time-axis convention: circular mod T via slice-modulo staggered
+``BlockSpec``s (``t_slice`` divides T), the same trick as every other
+kernel in this package.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+#: tree levels fused into the VMEM-resident head; 2^HEAD_LEVELS channels
+#: per independent group (128 -> ~260 live rows per group, ~5 MB VMEM)
+HEAD_LEVELS = 7
+
+#: default time-slice (samples); must divide T and hold the head halo
+HEAD_T_SLICE = 2048
+
+#: lane width of the chunked-row layout (one (8, L) chunk = 2048 samples).
+#: 256 lanes keep the per-row vector ops wide (the first cut used 128 and
+#: measured SLOWER than the per-level kernel: 8x narrower ops than its
+#: (8, 1024) tiles drowned the HBM win in instruction overhead); it also
+#: lets every head-level shifted read take the static-base fast path —
+#: all head-level shifts are < L by eligibility, so the 16-row load base
+#: is static and no dynamic sublane rotate is ever issued.
+_L = 256
+_CHUNK = 8 * _L
+
+#: rows per fori_loop iteration of the head kernel.  The scalar core's
+#: per-iteration overhead (loop control + dynamic address formation)
+#: dominated the un-unrolled kernel (~110 ns/row vs ~20 ns of vector
+#: work -> 0.53 s, SLOWER than the per-level path's 0.37 s); unrolling
+#: by 8 amortises it and flips the comparison (0.32 s measured, v5e
+#: 1024 x 1M benchmark); 16 regresses hard (4.2 s measured — register
+#: pressure/spill pathology), so 8 is pinned.
+_ROW_UNROLL = 8
+
+
+def _pad_stack(arrs, rows_max):
+    """Stack per-group 1-D tables padded (repeat last entry) to rows_max."""
+    out = np.empty((len(arrs), rows_max), np.int32)
+    for g, a in enumerate(arrs):
+        a = np.asarray(a, np.int32)
+        if len(a) == 0:
+            raise ValueError("empty group table")
+        out[g, :len(a)] = a
+        out[g, len(a):] = a[-1]
+    return out
+
+
+class HeadPlan:
+    """Static per-group merge schedule for the fused head.
+
+    Built from an :class:`~pulsarutils_tpu.ops.fdmt.FdmtPlan`: the first
+    ``n_levels`` iterations' flat tables are re-based to each
+    ``2^n_levels``-channel group's own input-row window and padded to the
+    per-level max row count over groups (padded rows repeat the last
+    real row — they compute junk that nothing references and that is
+    sliced off host-side).
+    """
+
+    def __init__(self, plan, n_levels=HEAD_LEVELS):
+        chan_group = 1 << n_levels
+        nchp = plan.nchan_padded
+        if nchp < chan_group or len(plan.iterations) < n_levels:
+            raise ValueError(
+                f"head needs nchan_padded >= {chan_group} and >= "
+                f"{n_levels} iterations")
+        self.n_levels = n_levels
+        self.n_groups = nchp // chan_group
+        self.rows_in = chan_group
+
+        self.tables = []       # per level: group-local padded tables
+        self.rows_out = []     # per level: padded (max) rows per group
+        # per-input-band start rows; level 0's input bands are the raw
+        # channels themselves (one row each)
+        in_offsets = np.arange(nchp + 1)
+        for lev in range(n_levels):
+            it = plan.iterations[lev]
+            nd = np.asarray(it["ndelay"])
+            out_offsets = np.concatenate([[0], np.cumsum(nd)])
+            n_bands_in = len(in_offsets) - 1
+            n_bands_out = len(nd)
+            bpg_in = n_bands_in // self.n_groups
+            bpg_out = n_bands_out // self.n_groups
+            assert bpg_out * self.n_groups == n_bands_out, (lev, n_bands_out)
+            ils, ihs, ss, shs, counts = [], [], [], [], []
+            for g in range(self.n_groups):
+                r0 = out_offsets[g * bpg_out]
+                r1 = out_offsets[(g + 1) * bpg_out]
+                in_start = int(in_offsets[g * bpg_in])
+                in_end = int(in_offsets[(g + 1) * bpg_in])
+                il = it["idx_low"][r0:r1] - in_start
+                ih = it["idx_high"][r0:r1] - in_start
+                # bands merge strictly within the group: group-local
+                # indices must land inside the group's input window
+                assert il.min() >= 0 and ih.min() >= 0, (lev, g)
+                assert max(il.max(), ih.max()) < in_end - in_start, (lev, g)
+                ils.append(il)
+                ihs.append(ih)
+                ss.append(it["shift"][r0:r1])
+                shs.append(it["shift_high"][r0:r1]
+                           if it["shift_high"] is not None
+                           else np.zeros(r1 - r0, np.int32))
+                counts.append(int(r1 - r0))
+            # padded to the row-loop unroll factor (amortises the
+            # scalar loop/address overhead per iteration)
+            rows_max = -(-max(counts) // _ROW_UNROLL) * _ROW_UNROLL
+            self.rows_out.append(rows_max)
+            self.tables.append({
+                "idx_low": _pad_stack(ils, rows_max),
+                "idx_high": _pad_stack(ihs, rows_max),
+                "shift": _pad_stack(ss, rows_max),
+                "shift_high": _pad_stack(shs, rows_max),
+                "counts": np.asarray(counts),
+                "leaf": it["shift_high"] is not None,
+            })
+            in_offsets = out_offsets[::bpg_out]
+        self.rows_valid = self.tables[-1]["counts"]  # real final counts
+        self.row_starts = np.concatenate(
+            [[0], np.cumsum(self.rows_valid)])[:-1]
+        self.rows_total = int(self.rows_valid.sum())
+        #: cumulative worst-case shift a sample travels through the head
+        self.max_shift_per_level = [
+            int(t["shift"].max(initial=0)) for t in self.tables]
+        self.max_shift_per_level[0] = max(
+            self.max_shift_per_level[0],
+            int(self.tables[0]["shift_high"].max(initial=0)))
+        self.halo = int(sum(self.max_shift_per_level))
+
+    def remaining_halo(self, lev):
+        """Cumulative max shift applied at levels ``lev..end`` — how far
+        past ``t_slice`` level ``lev``'s INPUT must stay valid."""
+        return int(sum(self.max_shift_per_level[lev:]))
+
+
+@functools.lru_cache(maxsize=8)
+def _head_plan_cached(nchan, start_freq, bandwidth, max_delay, min_delay,
+                      n_levels):
+    from .fdmt import fdmt_plan
+
+    return HeadPlan(fdmt_plan(nchan, start_freq, bandwidth, max_delay,
+                              min_delay), n_levels)
+
+
+@functools.lru_cache(maxsize=8)
+def _build_head_kernel(nchan, start_freq, bandwidth, max_delay, min_delay,
+                       n_levels, t, t_slice, interpret):
+    """Compile the fused-head pallas program for one (plan, T) config."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    head = _head_plan_cached(nchan, start_freq, bandwidth, max_delay,
+                             min_delay, n_levels)
+    assert t % t_slice == 0 and t_slice % _CHUNK == 0
+    # the static-base fast path requires every level's shift < one lane
+    # row (head_supported enforces it; belt and braces here)
+    assert max(head.max_shift_per_level) < _L, head.max_shift_per_level
+    n_slices = t // t_slice
+    cpb = t_slice // _CHUNK          # (8, L) chunks per slice
+    # input window must cover t_slice + head halo; at n_slices == 1 the
+    # staggered (i_s + k) % n_slices maps all fetch slice 0 — which IS
+    # the circular wrap for T == t_slice, so no special case (an early
+    # `else 1` here left the halo region of the buffer unstitched and
+    # the last `halo` output samples read uninitialised VMEM)
+    k_in = -(-(t_slice + head.halo) // t_slice)
+    # chunk extents: level lev's input must stay valid over
+    # t_slice + remaining_halo(lev); +1 chunk so the 16-row loads (8
+    # rows past a chunk's base) never run off the buffer
+    chunks_alloc = max(-(-(t_slice + head.halo) // _CHUNK),
+                       k_in * cpb) + 1
+    r_alloc = chunks_alloc * 8
+    rows_buf = max([head.rows_in] + head.rows_out)
+
+    grid = (head.n_groups, n_slices)
+
+    # index maps receive the scalar-prefetch refs after the grid indices
+    in_specs = [
+        pl.BlockSpec((head.rows_in, cpb, 8, _L),
+                     functools.partial(
+                         lambda g, i_s, *_tabs, _k: (g, (i_s + _k)
+                                                     % n_slices, 0, 0),
+                         _k=k))
+        for k in range(k_in)
+    ]
+    out_spec = pl.BlockSpec((head.rows_out[-1], cpb, 8, _L),
+                            lambda g, i_s, *_tabs: (g, i_s, 0, 0))
+
+    n_chunks_out = [-(-(t_slice + head.remaining_halo(lev + 1)) // _CHUNK)
+                    for lev in range(n_levels)]
+    n_chunks_out[-1] = cpb  # the head's output is exactly the slice
+
+    def kernel(*args):
+        # scalar prefetch: 4 tables per level, each (n_groups, rows_max)
+        tabs = args[:4 * n_levels]
+        in_refs = args[4 * n_levels:4 * n_levels + k_in]
+        out_ref = args[4 * n_levels + k_in]
+        buf_a = args[4 * n_levels + k_in + 1]
+        buf_b = args[4 * n_levels + k_in + 2]
+
+        g = pl.program_id(0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (8, _L), 1)
+
+        # stitch the staggered input blocks into the level-0 buffer
+        for k in range(k_in):
+            for j in range(cpb):
+                buf_a[:head.rows_in,
+                      pl.ds((k * cpb + j) * 8, 8), :] = in_refs[k][:, j]
+
+        def shifted_chunk(src, row, c, s):
+            """``src[row, c*CHUNK + s : +CHUNK]`` as an (8, L) tile.
+
+            Every head shift is < L (eligibility), so the 16-row load
+            base ``c*8`` is STATIC — one aligned load, one dynamic
+            lane-rotate, one two-row blend; no dynamic sublane rotate
+            (the same q0 specialisation as the dedispersion kernel).
+            """
+            rows16 = src[row, pl.ds(c * 8, 16), :]
+            rolled = pltpu.roll(rows16, (_L - s) % _L, 1)
+            return jnp.where(lane < _L - s, rolled[0:8], rolled[1:9])
+
+        src, dst = buf_a, buf_b
+        for lev in range(n_levels):
+            il_t, ih_t, s_t, sh_t = tabs[4 * lev:4 * lev + 4]
+            leaf = head.tables[lev]["leaf"]
+            final = lev == n_levels - 1
+            nco = n_chunks_out[lev]
+
+            def row_body(rb, _, il_t=il_t, ih_t=ih_t, s_t=s_t, sh_t=sh_t,
+                         leaf=leaf, final=final, nco=nco, src=src, dst=dst):
+                # row unroll: one loop iteration's scalar overhead
+                # (control flow + dynamic address formation) amortised
+                # over _ROW_UNROLL rows of vector work
+                for dr in range(_ROW_UNROLL):
+                    r = rb * _ROW_UNROLL + dr
+                    il = il_t[g, r]
+                    ih = ih_t[g, r]
+                    s = s_t[g, r]
+                    for c in range(nco):
+                        low = shifted_chunk(src, il, c, s)
+                        if leaf:
+                            high = shifted_chunk(src, ih, c, sh_t[g, r])
+                        else:
+                            high = src[ih, pl.ds(c * 8, 8), :]
+                        tile = low + high
+                        if final:
+                            out_ref[r, c] = tile
+                        else:
+                            dst[r, pl.ds(c * 8, 8), :] = tile
+                return 0
+
+            jax.lax.fori_loop(0, head.rows_out[lev] // _ROW_UNROLL,
+                              row_body, 0)
+            src, dst = dst, src
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4 * n_levels,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((rows_buf, r_alloc, _L), jnp.float32),
+            pltpu.VMEM((rows_buf, r_alloc, _L), jnp.float32),
+        ],
+    )
+    call = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (head.n_groups * head.rows_out[-1], n_slices * cpb, 8, _L),
+            jnp.float32),
+        interpret=bool(interpret))
+
+    flat_tabs = []
+    for tab in head.tables:
+        flat_tabs += [jnp.asarray(tab[k]) for k in
+                      ("idx_low", "idx_high", "shift", "shift_high")]
+
+    # host-side reassembly index: global level-n row -> (group, local row)
+    gather_g = np.concatenate(
+        [np.full(c, g) for g, c in enumerate(head.rows_valid)])
+    gather_r = np.concatenate(
+        [np.arange(c) for c in head.rows_valid])
+
+    def run(data):
+        # traceable (un-jitted) so the whole-transform jit can inline it
+        data4 = data.reshape(data.shape[0], n_slices * cpb, 8, _L)
+        out = call(*flat_tabs, *([data4] * k_in))
+        # (G*rows_max, n_chunks, 8, L) -> (rows_total, t)
+        out = out.reshape(head.n_groups, head.rows_out[-1], t)
+        return out[jnp.asarray(gather_g), jnp.asarray(gather_r)]
+
+    return run, head
+
+
+def head_transform(data, max_delay, start_freq, bandwidth, min_delay=0,
+                   n_levels=HEAD_LEVELS, t_slice=None, interpret=None):
+    """Run the fused head: raw (nchan, T) -> level-``n_levels`` state.
+
+    Returns the same float32 rows the first ``n_levels`` per-level
+    merges would produce (bit-identical), band-major.  The caller feeds
+    this into the remaining per-level merges.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    data = jnp.asarray(data, jnp.float32)
+    nchan, t = data.shape
+    if t_slice is None:
+        t_slice = HEAD_T_SLICE
+    run, head = _build_head_kernel(
+        nchan, float(start_freq), float(bandwidth), int(max_delay),
+        int(min_delay), int(n_levels), int(t), int(t_slice),
+        bool(interpret))
+    if nchan < head.rows_in * head.n_groups:
+        data = jnp.concatenate(
+            [data, jnp.zeros((head.rows_in * head.n_groups - nchan, t),
+                             jnp.float32)])
+    return jax.jit(run)(data)
+
+
+def head_supported(nchan_padded, n_iterations, t, t_slice=None,
+                   halo=None, max_level_shift=None):
+    """Static eligibility check shared with the transform integration."""
+    t_slice = t_slice or HEAD_T_SLICE
+    if nchan_padded < (1 << HEAD_LEVELS) or n_iterations <= HEAD_LEVELS:
+        return False
+    if t % t_slice or t_slice % _CHUNK:
+        return False
+    if halo is not None and halo > (2 * t_slice) // 3:
+        return False  # halo-dominated slices waste the residency win
+    if max_level_shift is not None and max_level_shift >= _L:
+        return False  # static-base shifted reads need shifts < one row
+    return True

@@ -139,8 +139,13 @@ def score_profiles_chunked(plane, xp, chunk=512, with_cert=False):
     statically-unrolled chunk loop bounds the scorer's live temps to
     ~``chunk/ndm`` of that, still emitting ONE ``(5, ndm)`` array (one
     host readback round trip) — ``(6, ndm)`` with ``with_cert`` (the
-    hybrid's sliding certificate row appended).
+    hybrid's sliding certificate row appended).  The cert row's three
+    sliding sums add ~3 more plane-sized temps, so its chunk is capped
+    at 128 rows: at 512 x 1M the uncapped 512-row chunk pushed the
+    coarse program to a measured 16.25 GB HBM compile-OOM.
     """
+    if with_cert:
+        chunk = min(chunk, 128)
     rows = plane.shape[0]
 
     def one(sub):
@@ -352,7 +357,8 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     """
     import jax.numpy as jnp
 
-    from .fdmt import _build_transform, _transform_setup, fdmt_trial_dms
+    from .fdmt import (_build_transform, _head_enabled, _transform_setup,
+                       fdmt_trial_dms)
 
     nchan = data.shape[0]
     trial_dms, n_lo, n_hi = fdmt_trial_dms(nchan, dmmin, dmmax, start_freq,
@@ -367,7 +373,8 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                            n_hi, t_run, t_tile, use_pallas, interpret,
                            n_lo=n_lo, with_scores=True,
                            with_plane=capture_plane, t_orig=t_orig,
-                           with_cert=with_cert)
+                           with_cert=with_cert,
+                           use_head=_head_enabled(use_pallas))
     out = run(data)
     if capture_plane:
         stacked, plane_out = out  # plane stays device-resident
@@ -645,7 +652,7 @@ HYBRID_SEED_TOPK = 10
 @functools.lru_cache(maxsize=8)
 def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, n_lo, t_orig, max_off, ndm_plan,
-                              bucket):
+                              bucket, use_head=False):
     """ONE jitted program for the hybrid's first round on TPU:
 
     FDMT coarse sweep -> plan-grid score mapping -> device-side top-k
@@ -669,7 +676,8 @@ def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
     coarse_fn = _transform_fn(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, True, False, n_lo=n_lo,
                               with_scores=True, with_plane=False,
-                              t_orig=t_orig, with_cert=True)
+                              t_orig=t_orig, with_cert=True,
+                              use_head=use_head)
     k = min(HYBRID_SEED_TOPK, ndm_plan)  # top_k requires k <= axis size
 
     @jax.jit
@@ -852,9 +860,15 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         bucket = HYBRID_RESCORE_BUCKETS[-1]
         assert bucket >= 3 * HYBRID_SEED_TOPK
         t_tile = _pick_fdmt_tile(nsamples)
+        from .fdmt import _head_enabled
+
+        # the head flag is resolved HERE so it keys the builder's lru
+        # cache (an in-builder env read would serve a stale compiled
+        # program after toggling PUTPU_FDMT_HEAD in-process)
         kernel = _fused_hybrid_seed_kernel(
             nchan, float(start_freq), float(bandwidth), n_hi, nsamples,
-            t_tile, n_lo, None, max_off, ndm, bucket)
+            t_tile, n_lo, None, max_off, ndm, bucket,
+            use_head=_head_enabled(True))
         offs_dev = _device_offsets_cache(rebased_full.tobytes(),
                                          rebased_full.shape)
         packed = np.asarray(kernel(data32, jnp.asarray(idx.astype(np.int32)),
