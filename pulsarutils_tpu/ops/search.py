@@ -453,8 +453,12 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
 #: rescore-call row buckets (requested rows pad up to the next bucket);
 #: a small set of static shapes keeps compiles bounded while not paying
-#: the biggest block's VPU cost for a handful of rows
-HYBRID_RESCORE_BUCKETS = (8, 16, 32)
+#: the biggest block's VPU cost for a handful of rows.  Top bucket 16
+#: (round-3 A/B, v5e 1M headline): seed bucket 32 with top-10 measured
+#: 0.559 s, 16 with top-5 0.489 s (same exact argbest; the guarantee
+#: loop backstops any seed), bucket 8 with top-2 regressed to 0.664 s
+#: (seed too small — extra loop rounds cost more than they saved).
+HYBRID_RESCORE_BUCKETS = (8, 16)
 
 #: hard cap on guarantee-loop iterations before the hybrid falls back to
 #: rescoring every remaining candidate row (correctness is then trivial)
@@ -645,8 +649,9 @@ def hybrid_certificate_gate(cert_scores, coarse_snrs, snrs, exact, rescore,
 
 
 #: top-k coarse rows the fused seed program rescores device-side (plus
-#: grid neighbours, padded to one HYBRID_RESCORE_BUCKETS[-1] bucket)
-HYBRID_SEED_TOPK = 10
+#: grid neighbours, padded to one HYBRID_RESCORE_BUCKETS[-1] bucket);
+#: 5 pairs with the 16-row bucket (see HYBRID_RESCORE_BUCKETS' A/B)
+HYBRID_SEED_TOPK = 5
 
 
 @functools.lru_cache(maxsize=8)

@@ -69,7 +69,7 @@ class TestHead:
         # halo equals the sum of per-level worst shifts
         assert hp.halo == sum(hp.max_shift_per_level)
 
-    def test_full_transform_with_head_matches(self):
+    def test_full_transform_with_head_matches(self, tmp_path):
         """End-to-end: the full search with PUTPU_FDMT_HEAD=1 must equal
         the head-off transform bit-for-bit (subprocess: the knob keys
         compile caches at import-free call time, so each setting gets a
@@ -85,8 +85,8 @@ out = np.asarray(fdmt_transform(data, 250, 1200., 200., min_delay=100))
 np.save(%r, out)
 """
         outs = []
-        for knob, path in (("0", "/tmp/fdmt_head_off.npy"),
-                           ("1", "/tmp/fdmt_head_on.npy")):
+        for knob, path in (("0", str(tmp_path / "head_off.npy")),
+                           ("1", str(tmp_path / "head_on.npy"))):
             env = dict(os.environ, PUTPU_FDMT_HEAD=knob)
             r = subprocess.run([sys.executable, "-c", code % path],
                                env=env, capture_output=True, text=True,
