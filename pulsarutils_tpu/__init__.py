@@ -90,40 +90,56 @@ def test(extra_args=None):
     return int(proc.returncode)
 
 
+#: lazy re-exports of the pipeline/IO/parallel layer (keeps bare
+#: ``import pulsarutils_tpu`` light — no matplotlib / file machinery).
+#: __all__ appends these names automatically — ONE table to maintain.
+#: Note: ``from pulsarutils_tpu import *`` resolves every lazy name and
+#: thereby imports all the submodules — the laziness serves plain
+#: ``import pulsarutils_tpu``, which star-import deliberately trades
+#: away for a complete namespace.
+_LAZY = {
+    "search_by_chunks": ("pipeline.search_pipeline", "search_by_chunks"),
+    "cleanup_data": ("pipeline.cleanup", "cleanup_data"),
+    "get_bad_chans": ("pipeline.spectral_stats", "get_bad_chans"),
+    "get_spectral_stats": ("pipeline.spectral_stats",
+                           "get_spectral_stats"),
+    "PulseInfo": ("pipeline.pulse_info", "PulseInfo"),
+    "plot_diagnostics": ("pipeline.diagnostics", "plot_diagnostics"),
+    "sift_hits": ("pipeline.sift", "sift_hits"),
+    "sift_candidates": ("pipeline.sift", "sift_candidates"),
+    "FilterbankReader": ("io.sigproc", "FilterbankReader"),
+    "FilterbankWriter": ("io.sigproc", "FilterbankWriter"),
+    "write_filterbank": ("io.sigproc", "write_filterbank"),
+    "CandidateStore": ("io.candidates", "CandidateStore"),
+    "sharded_dedispersion_search": ("parallel.sharded",
+                                    "sharded_dedispersion_search"),
+    "sharded_fdmt_search": ("parallel.sharded_fdmt",
+                            "sharded_fdmt_search"),
+    "sharded_hybrid_search": ("parallel.sharded_fdmt",
+                              "sharded_hybrid_search"),
+    "ring_dedisperse": ("parallel.stream", "ring_dedisperse"),
+    "make_mesh": ("parallel.mesh", "make_mesh"),
+    "fdmt_transform": ("ops.fdmt", "fdmt_transform"),
+    "fdmt_trial_dms": ("ops.fdmt", "fdmt_trial_dms"),
+    "fdmt_tracks": ("ops.fdmt", "fdmt_tracks"),
+    "initialize_distributed": ("parallel.multihost", "initialize"),
+    "pod_mesh": ("parallel.multihost", "pod_mesh"),
+    # hybrid soundness bounds / noise certificate (round 3)
+    "cert_retention": ("ops.certify", "cert_retention"),
+    "coarse_retention": ("ops.certify", "coarse_retention"),
+    "retention_bound": ("ops.certify", "retention_bound"),
+    "certify_noise_only": ("ops.certify", "certify_noise_only"),
+    "certifiable_snr_floor": ("ops.certify", "certifiable_snr_floor"),
+    "matched_snr_floor": ("ops.certify", "matched_snr_floor"),
+    "expected_noise_max_snr": ("ops.certify", "expected_noise_max_snr"),
+}
+
+
 def __getattr__(name):
-    """Lazy re-exports of the pipeline/IO layer (keeps bare ``import
-    pulsarutils_tpu`` light — no matplotlib / file machinery)."""
-    lazy = {
-        "search_by_chunks": ("pipeline.search_pipeline", "search_by_chunks"),
-        "cleanup_data": ("pipeline.cleanup", "cleanup_data"),
-        "get_bad_chans": ("pipeline.spectral_stats", "get_bad_chans"),
-        "get_spectral_stats": ("pipeline.spectral_stats",
-                               "get_spectral_stats"),
-        "PulseInfo": ("pipeline.pulse_info", "PulseInfo"),
-        "plot_diagnostics": ("pipeline.diagnostics", "plot_diagnostics"),
-        "sift_hits": ("pipeline.sift", "sift_hits"),
-        "sift_candidates": ("pipeline.sift", "sift_candidates"),
-        "FilterbankReader": ("io.sigproc", "FilterbankReader"),
-        "FilterbankWriter": ("io.sigproc", "FilterbankWriter"),
-        "write_filterbank": ("io.sigproc", "write_filterbank"),
-        "CandidateStore": ("io.candidates", "CandidateStore"),
-        "sharded_dedispersion_search": ("parallel.sharded",
-                                        "sharded_dedispersion_search"),
-        "sharded_fdmt_search": ("parallel.sharded_fdmt",
-                                "sharded_fdmt_search"),
-        "sharded_hybrid_search": ("parallel.sharded_fdmt",
-                                  "sharded_hybrid_search"),
-        "ring_dedisperse": ("parallel.stream", "ring_dedisperse"),
-        "make_mesh": ("parallel.mesh", "make_mesh"),
-        "fdmt_transform": ("ops.fdmt", "fdmt_transform"),
-        "fdmt_trial_dms": ("ops.fdmt", "fdmt_trial_dms"),
-        "initialize_distributed": ("parallel.multihost", "initialize"),
-        "pod_mesh": ("parallel.multihost", "pod_mesh"),
-    }
-    if name in lazy:
+    if name in _LAZY:
         import importlib
 
-        module, attr = lazy[name]
+        module, attr = _LAZY[name]
         return getattr(importlib.import_module(f".{module}", __name__), attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
@@ -162,4 +178,4 @@ __all__ = [
     "simulate_test_data",
     "simulate_pulsar_data",
     "ResultTable",
-]
+] + list(_LAZY)  # lazy names: one table, no drift (see _LAZY)

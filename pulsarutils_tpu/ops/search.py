@@ -453,12 +453,12 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
 #: rescore-call row buckets (requested rows pad up to the next bucket);
 #: a small set of static shapes keeps compiles bounded while not paying
-#: the biggest block's VPU cost for a handful of rows.  Top bucket 16
-#: (round-3 A/B, v5e 1M headline): seed bucket 32 with top-10 measured
-#: 0.559 s, 16 with top-5 0.489 s (same exact argbest; the guarantee
-#: loop backstops any seed), bucket 8 with top-2 regressed to 0.664 s
-#: (seed too small — extra loop rounds cost more than they saved).
-HYBRID_RESCORE_BUCKETS = (8, 16)
+#: the biggest block's VPU cost for a handful of rows.  The 32-row top
+#: bucket matters for LARGE rescans (the round-budget fallback rescores
+#: every remaining row — halving the top bucket would double its tunnel
+#: dispatches); the fused seed uses its own smaller
+#: :data:`HYBRID_SEED_BUCKET`.
+HYBRID_RESCORE_BUCKETS = (8, 16, 32)
 
 #: hard cap on guarantee-loop iterations before the hybrid falls back to
 #: rescoring every remaining candidate row (correctness is then trivial)
@@ -649,9 +649,18 @@ def hybrid_certificate_gate(cert_scores, coarse_snrs, snrs, exact, rescore,
 
 
 #: top-k coarse rows the fused seed program rescores device-side (plus
-#: grid neighbours, padded to one HYBRID_RESCORE_BUCKETS[-1] bucket);
-#: 5 pairs with the 16-row bucket (see HYBRID_RESCORE_BUCKETS' A/B)
+#: grid neighbours, padded to one HYBRID_SEED_BUCKET)
 HYBRID_SEED_TOPK = 5
+
+#: rows the fused first-round program rescores — the headline's
+#: dominant rescore cost.  Round-3 A/B (v5e 1M headline): bucket 32
+#: with top-10 measured 0.559 s, bucket 16 with top-5 0.489 s (same
+#: exact argbest; the guarantee loop backstops any seed), bucket 8
+#: with top-2 regressed to 0.664 s (seed too small — extra loop rounds
+#: cost more than they saved).  Deliberately decoupled from
+#: HYBRID_RESCORE_BUCKETS so shrinking the seed does not shrink the
+#: max block of large guarantee-loop rescans.
+HYBRID_SEED_BUCKET = 16
 
 
 @functools.lru_cache(maxsize=8)
@@ -862,7 +871,7 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         # tunnel round trip costs ~0.1 s).  Requires the unpadded time
         # axis (a pad would shift the rescore's circular wrap off the
         # exact kernels' convention).
-        bucket = HYBRID_RESCORE_BUCKETS[-1]
+        bucket = HYBRID_SEED_BUCKET
         assert bucket >= 3 * HYBRID_SEED_TOPK
         t_tile = _pick_fdmt_tile(nsamples)
         from .fdmt import _head_enabled
