@@ -543,6 +543,30 @@ def _head_enabled(use_pallas):
     return bool(use_pallas)
 
 
+def head_active(nchan, start_freq, bandwidth, max_delay, n_lo, t):
+    """True iff the fused head WILL run for this transform config.
+
+    THE eligibility gate — `_transform_fn` consults it and so must any
+    A/B harness (tools/tpu_smoke.py's head parity check): a
+    hand-replicated copy of these conditions could silently diverge and
+    turn the A/B vacuous.
+    """
+    from .fdmt_resident import (
+        HEAD_LEVELS,
+        _head_plan_cached,
+        head_supported,
+    )
+
+    plan = fdmt_plan(nchan, start_freq, bandwidth, max_delay, n_lo)
+    if not head_supported(plan.nchan_padded, len(plan.iterations), t):
+        return False
+    hp = _head_plan_cached(nchan, start_freq, bandwidth, max_delay, n_lo,
+                           HEAD_LEVELS)
+    return head_supported(plan.nchan_padded, len(plan.iterations), t,
+                          halo=hp.halo,
+                          max_level_shift=max(hp.max_shift_per_level))
+
+
 @functools.lru_cache(maxsize=16)
 def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                   use_pallas, interpret, n_lo=0, with_scores=False,
@@ -572,25 +596,18 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
     # overrides) so it keys the compile caches.
     head_run = None
     n_head = 0
-    if use_head:
+    if use_head and head_active(nchan, start_freq, bandwidth, max_delay,
+                                n_lo, t):
         from .fdmt_resident import (
             HEAD_LEVELS,
             HEAD_T_SLICE,
             _build_head_kernel,
-            _head_plan_cached,
-            head_supported,
         )
 
-        if head_supported(plan.nchan_padded, len(plan.iterations), t):
-            hp = _head_plan_cached(nchan, start_freq, bandwidth,
-                                   max_delay, n_lo, HEAD_LEVELS)
-            if head_supported(plan.nchan_padded, len(plan.iterations), t,
-                              halo=hp.halo,
-                              max_level_shift=max(hp.max_shift_per_level)):
-                head_run, _ = _build_head_kernel(
-                    nchan, start_freq, bandwidth, max_delay, n_lo,
-                    HEAD_LEVELS, t, HEAD_T_SLICE, interpret)
-                n_head = HEAD_LEVELS
+        head_run, _ = _build_head_kernel(
+            nchan, start_freq, bandwidth, max_delay, n_lo,
+            HEAD_LEVELS, t, HEAD_T_SLICE, interpret)
+        n_head = HEAD_LEVELS
 
     def fn(data):
         state = data

@@ -169,6 +169,39 @@ def _fdmt():
     assert abs(dm - 150) < 3, dm
 
 
+@check("fdmt: fused VMEM-resident head bit-identical on hardware")
+def _fdmt_head():
+    import numpy as np
+
+    from pulsarutils_tpu.ops.fdmt import _build_transform, fdmt_trial_dms
+
+    # compiled (not interpret-mode) head vs per-level path must agree
+    # byte-for-byte — use_head keys the compile caches, so both variants
+    # build in one process
+    nchan, t = 256, 1 << 14
+    _, n_lo, n_hi = fdmt_trial_dms(nchan, 300.0, 450.0, 1200.0, 200.0,
+                                   5e-4)
+    # guard against a vacuous pass: if eligibility rules are ever
+    # retuned so the head rejects this geometry, use_head=True silently
+    # falls back to the per-level path and the A/B would compare
+    # identical programs.  head_active is THE gate _transform_fn itself
+    # consults, so this cannot drift from the real condition.
+    from pulsarutils_tpu.ops.fdmt import head_active
+
+    assert head_active(nchan, 1200.0, 200.0, n_hi, n_lo, t), \
+        "head not eligible at the test geometry: the A/B would be vacuous"
+    rng = np.random.default_rng(4)
+    data = rng.normal(0, 1, (nchan, t)).astype(np.float32)
+    outs = []
+    for use_head in (False, True):
+        run = _build_transform(nchan, 1200.0, 200.0, n_hi, t, 8192, True,
+                               False, n_lo=n_lo, use_head=use_head)
+        outs.append(np.asarray(run(data)))
+    assert outs[0].shape == outs[1].shape
+    assert np.array_equal(outs[0], outs[1]), float(
+        np.abs(outs[0] - outs[1]).max())
+
+
 @check("fdmt: odd-length time axis (zero-pad path)")
 def _fdmt_odd():
     import numpy as np
