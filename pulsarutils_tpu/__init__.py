@@ -119,6 +119,7 @@ _LAZY = {
                               "sharded_hybrid_search"),
     "ring_dedisperse": ("parallel.stream", "ring_dedisperse"),
     "make_mesh": ("parallel.mesh", "make_mesh"),
+    "ShardedPlane": ("parallel.sharded_plane", "ShardedPlane"),
     "fdmt_transform": ("ops.fdmt", "fdmt_transform"),
     "fdmt_trial_dms": ("ops.fdmt", "fdmt_trial_dms"),
     "fdmt_tracks": ("ops.fdmt", "fdmt_tracks"),

@@ -425,7 +425,15 @@ def period_search_plane(plane, tsamp, max_harmonics=16, fmin=None, fmax=None,
     ndm, t = plane.shape
     if row_chunk is None:
         row_chunk = max(16, (1 << 27) // max(1, t))
-    if ndm <= row_chunk:
+    if hasattr(plane, "spectral_scores"):
+        # mesh path: the plane is a DM-sharded device-resident handle
+        # (:class:`~pulsarutils_tpu.parallel.sharded_plane.ShardedPlane`);
+        # stage 1 runs shard-locally on each device's rows and only the
+        # per-row score vectors come to host.  Stage 2 below fetches the
+        # refine rows individually (``plane[d]`` -> one host row).
+        spec = plane.spectral_scores(tsamp, max_harmonics=max_harmonics,
+                                     fmin=fmin, fmax=fmax)
+    elif ndm <= row_chunk:
         spec = _spectral_chunk(plane, tsamp, max_harmonics, fmin, fmax, xp)
     else:
         chunks = []

@@ -88,7 +88,8 @@ def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
 def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
                                 sample_time, mesh, *, trial_dms=None,
                                 capture_plane=False, chan_block=None,
-                                dtype=None, kernel="auto"):
+                                dtype=None, kernel="auto",
+                                plane_handle=False):
     """Run the full DM sweep sharded over ``mesh`` axes ``("dm", "chan")``.
 
     Same result contract as
@@ -99,6 +100,11 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
 
     ``kernel``: ``"auto"`` (per-shard Pallas kernel on TPU meshes, XLA
     gather elsewhere), ``"pallas"``, or ``"gather"``.
+
+    ``plane_handle`` (with ``capture_plane``) keeps the captured plane
+    DM-sharded and device-resident, returned as a
+    :class:`~.sharded_plane.ShardedPlane` instead of a host gather (the
+    mesh streaming diagnostics path).
     """
     import jax
     import jax.numpy as jnp
@@ -158,7 +164,12 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
 
     if capture_plane:
         stacked, plane = out
-        plane = np.asarray(plane)[:ndm]
+        if plane_handle:
+            from .sharded_plane import ShardedPlane
+
+            plane = ShardedPlane(plane, mesh, "dm", np.arange(ndm))
+        else:
+            plane = np.asarray(plane)[:ndm]
     else:
         stacked, plane = out, None
     maxvalues, stds, best_snrs, best_windows, best_peaks = unstack_scores(

@@ -108,13 +108,16 @@ def _stacked_tables(plans, t_tile):
 @functools.lru_cache(maxsize=8)
 def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
                         use_pallas, interpret, plan_key, t_orig,
-                        with_cert=False):
+                        with_cert=False, with_plane=False):
     """Compile the SPMD transform+score program for one mesh/geometry.
 
     ``plan_key`` carries the static per-iteration bounds (k_tiles,
     rows_max, ...) so the cache key captures the schedule shapes; the
     table *values* are runtime operands.  ``t`` is the (possibly padded)
     run length; scores are computed over the first ``t_orig`` samples.
+    ``with_plane`` additionally emits the final transform state — the
+    dedispersed plane, DM-sharded ``P(axis, None)`` and device-resident
+    (the mesh plane-products path, :mod:`.sharded_plane`).
     """
     import jax
     import jax.numpy as jnp
@@ -146,12 +149,14 @@ def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
         if t_orig != t:
             state = state[:, :t_orig]
         # score every (padded) row; junk rows are dropped host-side
-        return score_profiles_chunked(state, jnp,
-                                      with_cert=with_cert)[None]
+        scores = score_profiles_chunked(state, jnp,
+                                        with_cert=with_cert)[None]
+        return (scores, state) if with_plane else scores
 
     in_specs = [P()] + [P(axis)] * (4 * len(iter_meta))
+    out_specs = (P(axis), P(axis, None)) if with_plane else P(axis)
     fn = jax.jit(jax.shard_map(
-        local_fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=P(axis),
+        local_fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
         # pallas_call outputs carry no varying-mesh-axes metadata, which
         # trips shard_map's vma lint; there are no collectives at all in
         # this program, so the check adds nothing
@@ -161,7 +166,7 @@ def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
 
 def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
                         sample_time, mesh, axis="dm", use_pallas=None,
-                        with_cert=False):
+                        with_cert=False, capture_plane=False):
     """FDMT sweep with the trial-DM axis sharded over ``mesh[axis]``.
 
     Same scientific contract as ``dedispersion_search(kernel="fdmt")``
@@ -174,6 +179,11 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
 
     Returns a :class:`~pulsarutils_tpu.utils.table.ResultTable` with the
     usual ``DM, max, std, snr, rebin, peak`` columns over the full grid.
+    With ``capture_plane`` returns ``(table, plane)`` where ``plane`` is
+    a :class:`~pulsarutils_tpu.parallel.sharded_plane.ShardedPlane` —
+    the dedispersed plane left DM-sharded and device-resident, with
+    shard-local per-row products (the mesh diagnostics/period-search
+    path; the single-device path's host-gathered plane never exists).
     """
     import jax
     import jax.numpy as jnp
@@ -211,12 +221,26 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
 
     fn = _build_sharded_fdmt(mesh, axis, nchan, plans[0].nchan_padded,
                              t_run, t_tile, use_pallas, interpret,
-                             plan_key, t, with_cert)
+                             plan_key, t, with_cert, capture_plane)
     flat = []
     for it in tables:
         flat += [jnp.asarray(it[k]) for k in
                  ("idx_low", "idx_high", "shift", "shift_high")]
-    out = np.asarray(fn(data, *flat))
+    plane_handle = None
+    if capture_plane:
+        from .sharded_plane import ShardedPlane
+
+        out, plane = fn(data, *flat)
+        out = np.asarray(out)
+        # device d's padded shard starts at d * rows_max in the global
+        # concatenated plane; its first (hi-lo+1) rows are its slice
+        rows_max = plane.shape[0] // n_dev
+        row_index = np.concatenate(
+            [d * rows_max + np.arange(hi - lo + 1)
+             for d, (lo, hi) in enumerate(slices)])
+        plane_handle = ShardedPlane(plane, mesh, axis, row_index)
+    else:
+        out = np.asarray(fn(data, *flat))
 
     # stitch the dm-sharded scores: device d's first (hi-lo+1) rows are
     # its delay slice; the rest is padding junk
@@ -236,12 +260,13 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     }
     if with_cert:
         columns["cert"] = scores[5]
-    return ResultTable(columns)
+    table = ResultTable(columns)
+    return (table, plane_handle) if capture_plane else table
 
 
 def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
                           sample_time, mesh, snr_floor=None,
-                          noise_certificate=True):
+                          noise_certificate=True, capture_plane=False):
     """Hybrid (exact hits at coarse cost) over a ``(dm, chan)`` mesh.
 
     Multi-device composition of ``dedispersion_search(kernel="hybrid")``:
@@ -256,6 +281,12 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     kernel's scores (unless ``meta["certified"]``, which asserts no
     detection above ``snr_floor`` exists), with an ``exact`` column
     marking exact rows.
+
+    ``capture_plane`` returns ``(table, plane)`` with ``plane`` a
+    :class:`~.sharded_plane.ShardedPlane` over the *coarse* (FDMT) plane
+    remapped to the plan grid — the same coarse-plane convention as the
+    single-device hybrid's capture (``ops/search.py``:
+    ``_search_jax_hybrid``), kept DM-sharded and device-resident.
     """
     import jax.numpy as jnp
 
@@ -274,14 +305,18 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     # reuse the same device-resident array (sharded_dedispersion_search
     # passes aligned device inputs through untouched)
     data = jnp.asarray(data, jnp.float32)
-    t_coarse = sharded_fdmt_search(data, dmmin, dmmax, start_freq,
-                                   bandwidth, sample_time, mesh, axis="dm",
-                                   with_cert=True)
+    coarse_out = sharded_fdmt_search(data, dmmin, dmmax, start_freq,
+                                     bandwidth, sample_time, mesh,
+                                     axis="dm", with_cert=True,
+                                     capture_plane=capture_plane)
+    t_coarse, plane = coarse_out if capture_plane else (coarse_out, None)
     trial_dms = np.asarray(dedispersion_plan(
         nchan, dmmin, dmmax, start_freq, bandwidth, sample_time),
         dtype=np.float64)
     ndm = len(trial_dms)
     idx = nearest_rows(np.asarray(t_coarse["DM"]), trial_dms)
+    if plane is not None:
+        plane = plane.remap(idx)  # coarse rows -> plan grid, still sharded
 
     maxvalues = np.asarray(t_coarse["max"], np.float64)[idx]
     stds = np.asarray(t_coarse["std"], np.float64)[idx]
@@ -310,7 +345,7 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         trial_dms=trial_dms, start_freq=start_freq, bandwidth=bandwidth,
         sample_time=sample_time, nsamples=nsamples, snr_floor=snr_floor,
         noise_certificate=noise_certificate)
-    return ResultTable({
+    table = ResultTable({
         "DM": trial_dms,
         "max": maxvalues,
         "std": stds,
@@ -321,3 +356,4 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         "cert": cert_scores,
     }, meta={"certified": certified, "rho_cert": rho_cert_min,
              "snr_floor": snr_floor})
+    return (table, plane) if capture_plane else table

@@ -1,0 +1,213 @@
+"""Device-resident DM-sharded dedispersed plane with shard-local products.
+
+Round-3 verdict item 1: ``search_by_chunks(mesh=...)`` used to hard-reject
+``make_plots``/``period_search``, so the scaled-out path lost the
+periodicity search and the reference's flagship diagnostic figure
+(``pulsarutils/clean.py:192-269``, ``:252-255``) entirely.  This module
+restores both WITHOUT gathering the plane: the plane stays device-resident,
+sharded over the mesh's ``dm`` axis, and every plane consumer runs
+shard-locally, gathering only per-row score vectors (a few floats per DM
+trial), a time-decimated image for the figure's plane panel, and single
+rows on demand (the argbest profile, the period-refine series).
+
+Per-row products are row-local computations (spectra, H-tests, decimation
+all reduce over the time axis only), so sharding the row axis changes
+nothing numerically — with ONE documented exception: :meth:`ShardedPlane.
+h_curve`'s count digitisation (:func:`~pulsarutils_tpu.ops.robust.digitize`)
+normalises by the plane's median/MAD, which here is computed per device
+shard rather than globally.  On renormalised survey data the shards are
+statistically identical so the curves agree closely, but they are not
+bit-equal to the single-device curve (the tests pin the per-shard
+semantics instead).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["ShardedPlane"]
+
+
+@functools.lru_cache(maxsize=16)
+def _spectral_program(mesh, axis, tsamp, max_harmonics, fmin, fmax):
+    """One jitted shard-map program: per-row spectral search of the local
+    plane shard -> ``(5, rows_local)`` stacked scores (one readback)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.periodicity import _SPEC_KEYS, spectral_search
+
+    def local(rows):
+        # row-chunked like period_search_plane's host path: the batched
+        # rFFT allocates several (rows x T) temporaries, so an unchunked
+        # device shard would reintroduce the HBM blow-up the row_chunk
+        # bound exists to prevent (workspace kept near 0.5 GB/chunk);
+        # per-row results concatenate exactly
+        n, t = rows.shape
+        chunk = max(16, (1 << 27) // max(1, t))
+
+        def one(sub):
+            spec = spectral_search(sub, tsamp, max_harmonics=max_harmonics,
+                                   fmin=fmin, fmax=fmax, xp=jnp)
+            return jnp.stack([spec[k].astype(jnp.float32)
+                              for k in _SPEC_KEYS])
+
+        return jnp.concatenate(
+            [one(rows[lo:min(lo + chunk, n)])
+             for lo in range(0, n, chunk)], axis=1)
+
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=(P(axis, None),),
+                                 out_specs=P(None, axis)))
+
+
+@functools.lru_cache(maxsize=16)
+def _h_program(mesh, axis, window, nmax):
+    """Shard-local H-test per plane row (the figure's H-vs-DM curve).
+
+    Mirrors :func:`~pulsarutils_tpu.pipeline.diagnostics.plane_h_test`
+    (reference ``clean.py:252-255``) on the device shard: resample by the
+    candidate's boxcar window, digitise to counts, batched H-test.  The
+    digitisation stats (median/MAD) are per-shard — see the module
+    docstring.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.rebin import quick_resample
+    from ..ops.robust import digitize, h_test_batch
+
+    def local(rows):
+        r = quick_resample(rows, window, xp=jnp) if window > 1 else rows
+        counts = jnp.maximum(digitize(r, xp=jnp), 0)
+        h, m = h_test_batch(counts, nmax=nmax, xp=jnp)
+        return h.astype(jnp.float32), m.astype(jnp.int32)
+
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=(P(axis, None),),
+                                 out_specs=(P(axis), P(axis))))
+
+
+@functools.lru_cache(maxsize=16)
+def _decim_program(mesh, axis, factor):
+    """Shard-local time decimation (block sums, the reference's
+    ``quick_resample`` convention) for the figure's plane panel."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.rebin import quick_resample
+
+    def local(rows):
+        return quick_resample(rows, factor, xp=jnp)
+
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=(P(axis, None),),
+                                 out_specs=P(axis, None)))
+
+
+class ShardedPlane:
+    """Lazy handle over a device-resident, ``dm``-sharded plane.
+
+    ``plane`` is a global jax array ``(rows_padded, T)`` sharded
+    ``P(axis, None)`` over ``mesh``; ``row_index`` maps each table row
+    (plan/trial grid order) to its padded global row.  Consumers duck-type
+    on the methods below — anything accepting a plain ``(ndm, T)`` plane
+    can accept this handle where it only needs rows, per-row products, or
+    a decimated image.
+    """
+
+    def __init__(self, plane, mesh, axis, row_index):
+        self._plane = plane
+        self.mesh = mesh
+        self.axis = axis
+        self.row_index = np.asarray(row_index, dtype=np.int64)
+
+    @property
+    def shape(self):
+        return (len(self.row_index), int(self._plane.shape[1]))
+
+    @property
+    def ndim(self):
+        return 2
+
+    def remap(self, idx):
+        """A view of the same device plane under a new row order (the
+        hybrid maps the FDMT grid onto the plan grid this way)."""
+        return ShardedPlane(self._plane, self.mesh, self.axis,
+                            self.row_index[np.asarray(idx)])
+
+    def row(self, i):
+        """One table row as a host float array (fetches ~T floats)."""
+        return np.asarray(self._plane[int(self.row_index[int(i)])])
+
+    def __getitem__(self, i):
+        if not np.isscalar(i) and not isinstance(i, (int, np.integer)):
+            raise TypeError("ShardedPlane supports scalar row access only; "
+                            "use .to_host() to materialise the full plane")
+        return self.row(i)
+
+    def to_host(self):
+        """Materialise the FULL plane on host, table-row order (tests and
+        small-plane interop only — this is the gather the handle exists
+        to avoid)."""
+        return np.asarray(self._plane)[self.row_index]
+
+    # -- shard-local products -------------------------------------------
+
+    def spectral_scores(self, tsamp, max_harmonics=16, fmin=None, fmax=None):
+        """Per-row spectral search (periodicity stage 1), shard-local.
+
+        Same contract as the per-chunk spectral stage of
+        :func:`~pulsarutils_tpu.ops.periodicity.period_search_plane`:
+        returns ``{freq, power, nharm, log_sf, sigma}`` host arrays in
+        table-row order.
+        """
+        run = _spectral_program(self.mesh, self.axis, float(tsamp),
+                                int(max_harmonics),
+                                None if fmin is None else float(fmin),
+                                None if fmax is None else float(fmax))
+        from ..ops.periodicity import _SPEC_KEYS
+
+        stacked = np.asarray(run(self._plane))[:, self.row_index]
+        out = dict(zip(_SPEC_KEYS, stacked))
+        out["nharm"] = np.rint(out["nharm"]).astype(np.int32)
+        return out
+
+    def h_curve(self, window=1, nmax=None):
+        """Per-row H statistic (the figure's H-vs-DM curve), shard-local.
+
+        ``window`` is the candidate's best boxcar width (the same
+        resampling the single-device figure applies before
+        ``plane_h_test``).  Returns ``(h, m)`` host arrays in table-row
+        order.
+        """
+        t_r = self.shape[1] // max(1, int(window))
+        if nmax is None:
+            nmax = max(1, t_r // 10)
+        nmax = int(max(1, min(nmax, t_r // 2 if t_r >= 4 else 1)))
+        run = _h_program(self.mesh, self.axis, int(window), nmax)
+        h, m = run(self._plane)
+        return (np.asarray(h)[self.row_index],
+                np.asarray(m)[self.row_index])
+
+    def decimated(self, max_bins=2048):
+        """Time-decimated plane image for the figure's plane panel.
+
+        Returns ``(image, factor)``: block sums over ``factor`` samples
+        (``quick_resample`` convention, trailing partial block truncated),
+        in table-row order, at most ``max_bins`` time bins.
+        """
+        factor = max(1, -(-self.shape[1] // int(max_bins)))  # ceil: <= max_bins
+        if factor == 1:
+            # plane already small enough — still fetched via the sharded
+            # program path only when decimating; a factor-1 "decimation"
+            # is the identity, and at <= max_bins columns the gather is
+            # by definition within the decimated-image budget
+            return self.to_host(), 1
+        run = _decim_program(self.mesh, self.axis, factor)
+        return np.asarray(run(self._plane))[self.row_index], factor

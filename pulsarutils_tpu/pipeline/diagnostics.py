@@ -96,7 +96,18 @@ def build_diagnostic_figure(info, table, plane, t0=0.0, interactive=False):
     dedisp = apply_dm_shifts_to_data(array, shifts)
     array_r = quick_resample(array, window)
     dedisp_r = quick_resample(dedisp, window)
-    plane_r = quick_resample(np.asarray(plane), window)
+    if hasattr(plane, "h_curve"):
+        # mesh path: the plane is a DM-sharded device-resident handle
+        # (:class:`~pulsarutils_tpu.parallel.sharded_plane.ShardedPlane`).
+        # The two plane-consuming panels come from shard-local products:
+        # the H-vs-DM curve per row on device, and a time-decimated image
+        # for the plane panel (the full plane is never gathered).
+        h_values, _ = plane.h_curve(window)
+        plane_r, plane_factor = plane.decimated()
+    else:
+        plane_r = quick_resample(np.asarray(plane), window)
+        plane_factor = window
+        h_values, _ = plane_h_test(plane_r)
 
     allfreqs = np.linspace(info.start_freq, info.start_freq + info.bandwidth,
                            nchan + 1)
@@ -112,7 +123,11 @@ def build_diagnostic_figure(info, table, plane, t0=0.0, interactive=False):
         trial_dms.size > 1 else [trial_dms[0] + 0.5],
     ])
 
-    h_values, _ = plane_h_test(plane_r)
+    if plane_factor == window:
+        plane_tedges = tedges
+    else:  # decimated handle image: its own bin width
+        plane_tedges = (np.arange(plane_r.shape[1] + 1)
+                        * sample_time * plane_factor + t0)
 
     fig = plt.figure(figsize=(10, 8), dpi=60)
     gs = plt.GridSpec(3, 3, height_ratios=(1.5, 1, 1),
@@ -142,7 +157,7 @@ def build_diagnostic_figure(info, table, plane, t0=0.0, interactive=False):
     ax_ded.pcolormesh(tedges, allfreqs, dedisp_r, rasterized=True)
     ax_lc_raw.plot(times, array_r.mean(0), rasterized=True)
     ax_lc_ded.plot(times, dedisp_r.mean(0), rasterized=True)
-    ax_plane.pcolormesh(tedges, dm_edges, plane_r, rasterized=True)
+    ax_plane.pcolormesh(plane_tedges, dm_edges, plane_r, rasterized=True)
     ax_snr.plot(-np.asarray(table["snr"]), trial_dms)
     ax_h.plot(-h_values, trial_dms)
     ax_raw.set_xlim(t0, times[-1])

@@ -71,11 +71,10 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
 
     def run_one(b, k):
         if mesh is not None and b == "jax":
-            if capture_plane:
-                raise ValueError(
-                    "mesh streaming does not capture the dedispersed "
-                    "plane; disable make_plots/period_search or drop "
-                    "mesh=")
+            # plane capture on the mesh path stays DM-sharded and
+            # device-resident (a ShardedPlane handle; the downstream
+            # period search and diagnostics consume shard-local products
+            # instead of a gathered plane — see parallel/sharded_plane)
             from ..parallel.sharded import sharded_dedispersion_search
             from ..parallel.sharded_fdmt import (
                 sharded_fdmt_search,
@@ -85,14 +84,15 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
             if k == "hybrid":
                 return sharded_hybrid_search(
                     array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
-                    mesh=mesh, snr_floor=snr_floor)
+                    mesh=mesh, snr_floor=snr_floor,
+                    capture_plane=capture_plane)
             if k == "fdmt":
                 return sharded_fdmt_search(
                     array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
-                    mesh=mesh)
+                    mesh=mesh, capture_plane=capture_plane)
             return sharded_dedispersion_search(
                 array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
-                mesh=mesh)
+                mesh=mesh, capture_plane=capture_plane, plane_handle=True)
         return dedispersion_search(
             array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
             backend=b, kernel=k, capture_plane=capture_plane,
@@ -166,8 +166,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     ``mesh`` (a ``jax.sharding.Mesh``) routes every chunk through the
     multi-device sharded searches — the same device-resident chunk is
     searched by all devices (DM-sliced coarse stage + sharded exact
-    rescore for ``kernel="hybrid"``); plane capture (``make_plots`` /
-    ``period_search``) is not available on the mesh path.
+    rescore for ``kernel="hybrid"``).  ``make_plots``/``period_search``
+    work on the mesh path too: the captured plane stays DM-sharded and
+    device-resident, the periodicity spectra and the figure's per-row
+    H curve are computed shard-locally, and only per-row score vectors,
+    a decimated image and single rows are gathered
+    (:mod:`..parallel.sharded_plane`).
 
     ``show_plots=True`` additionally displays each diagnostic figure in
     an interactive window (the reference's ``show=True`` behaviour,
@@ -191,6 +195,14 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             and exact_floor != "auto":
         raise ValueError(f"exact_floor={exact_floor!r}: expected True, "
                          "False or 'auto'")
+    if mesh is not None and not {"dm", "chan"} <= set(mesh.shape):
+        # fail fast: a missing axis would otherwise surface as a KeyError
+        # inside the first chunk's search, which the failure-containment
+        # path misreads as a transient device fault and silently retries
+        # into the numpy fallback
+        raise ValueError(
+            f"mesh axes {tuple(mesh.shape)} must include 'dm' and 'chan' "
+            "(build one with make_mesh((d, c), ('dm', 'chan')))")
     logger.info("opening %s", fname)
     # strip only the final extension: "obs.day1.fil" and "obs.day2.fil"
     # must keep distinct candidate roots in a shared output directory
@@ -290,12 +302,6 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 "to force the all-detections-exact contract, or "
                 "snr_threshold='certifiable' for the noise-certificate "
                 "fast path)", snr_threshold, cert_floor)
-
-    if mesh is not None and (make_plots or period_search):
-        raise ValueError("mesh streaming does not capture the dedispersed "
-                         "plane: pass make_plots=False and "
-                         "period_search=False (diagnostics need the "
-                         "single-device path)")
 
     fingerprint = config_fingerprint(
         fname=os.path.abspath(str(fname)), dmmin=dmmin, dmmax=dmmax,

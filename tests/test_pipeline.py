@@ -451,14 +451,63 @@ def test_search_by_chunks_mesh(pulse_file, tmp_path):
     assert np.isclose(best[2].dm, best1[2].dm, atol=1e-6)
 
 
-def test_mesh_rejects_plane_consumers(pulse_file, tmp_path):
+def test_search_by_chunks_mesh_plane_products(pulse_file, tmp_path):
+    """VERDICT r3 #1: plane products work under mesh= — the scaled-out
+    path is no longer a capability subset.  Diagnostics and the period
+    search run on the DM-sharded device-resident plane; the injected
+    pulse is found with the exact argbest and its diagnostic figure is
+    rendered without ever gathering the plane."""
+    import jax
+
     from pulsarutils_tpu.parallel.mesh import make_mesh
 
-    path, _ = pulse_file
-    mesh = make_mesh((2,), ("dm",))
-    with pytest.raises(ValueError, match="mesh streaming"):
-        search_by_chunks(path, dmmin=100, dmmax=200, mesh=mesh,
-                         output_dir=str(tmp_path), make_plots="hits")
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    pytest.importorskip("matplotlib")
+    path, pulse_t = pulse_file
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    hits, store = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax", kernel="hybrid",
+        mesh=mesh, output_dir=str(tmp_path), make_plots="hits",
+        period_search=True, snr_threshold=6.0, resume=False,
+        tmin=8000 * 0.0005, max_chunks=4)
+    assert len(hits) >= 1
+    assert any(istart <= pulse_t < iend for istart, iend, _, _ in hits)
+    best = max(hits, key=lambda h: h[2].snr)
+    assert np.isclose(best[2].dm, 150, atol=2)
+    assert bool(best[3]["exact"][best[3].argbest()])
+    # the dedispersed profile came off the sharded plane (one row fetch)
+    assert best[2].dedisp_profile is not None
+    assert best[2].dedisp_profile.shape[0] > 0
+    # the diagnostic figure was rendered from shard-local products
+    jpgs = [f for f in os.listdir(str(tmp_path)) if f.endswith(".jpg")]
+    assert len(jpgs) >= 1
+
+
+def test_search_by_chunks_mesh_period_search(pulsar_file, tmp_path):
+    """Periodic pulsar recovered through the MESH streaming path (the
+    reference's plane H-test / folded search capability, scaled out)."""
+    import jax
+
+    from pulsarutils_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    path, period, dm = pulsar_file
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    hits, _ = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax", kernel="hybrid",
+        mesh=mesh, output_dir=str(tmp_path), make_plots=False,
+        snr_threshold=1e9,  # single-pulse path off: periodic-only hits
+        period_search=True, period_sigma_threshold=6.0, resume=False)
+    assert len(hits) >= 1
+    info = hits[0][2]
+    assert info.period_freq is not None
+    ratio = info.period_freq * period
+    assert abs(ratio - round(ratio)) < 0.06 and 1 <= round(ratio) <= 16
+    assert abs(info.period_dm - dm) < 20
+    assert info.period_sigma > 6.0
+    assert info.fold_profile is not None
 
 
 def test_snr_threshold_auto_resolves(pulse_file, tmp_path):

@@ -1,0 +1,194 @@
+"""ShardedPlane: device-resident DM-sharded plane + shard-local products.
+
+Round-3 verdict item 1: the mesh path must not be a capability subset —
+plane capture, per-row periodicity spectra, the per-row H curve and the
+figure's plane image all work without gathering the plane.
+"""
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.ops.plan import dedispersion_shifts
+from pulsarutils_tpu.ops.search import dedispersion_search
+from pulsarutils_tpu.parallel.mesh import make_mesh
+from pulsarutils_tpu.parallel.sharded import sharded_dedispersion_search
+from pulsarutils_tpu.parallel.sharded_fdmt import (
+    sharded_fdmt_search,
+    sharded_hybrid_search,
+    slice_delay_range,
+)
+
+
+@pytest.fixture(scope="module")
+def pulse_data():
+    rng = np.random.default_rng(7)
+    nchan, t = 64, 2048
+    data = rng.normal(size=(nchan, t)).astype(np.float32)
+    shifts = dedispersion_shifts(nchan, 150.0, 1400.0, 300.0, 1e-3)
+    for c in range(nchan):
+        data[c, (500 + int(round(shifts[c]))) % t] += 12.0
+    return data
+
+
+ARGS = (100, 200, 1400.0, 300.0, 1e-3)
+
+
+@pytest.fixture(scope="module")
+def fdmt_capture(pulse_data):
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    table, plane = sharded_fdmt_search(pulse_data, *ARGS, mesh=mesh,
+                                       capture_plane=True)
+    return table, plane
+
+
+def test_sharded_fdmt_plane_matches_single_device(pulse_data, fdmt_capture):
+    table, plane = fdmt_capture
+    t0, plane0 = dedispersion_search(pulse_data, *ARGS, backend="jax",
+                                     kernel="fdmt", capture_plane=True)
+    plane0 = np.asarray(plane0)
+    assert plane.shape == plane0.shape
+    np.testing.assert_allclose(plane.to_host(), plane0, atol=1e-3)
+    # scalar row fetch (the argbest-profile path) without a full gather
+    np.testing.assert_allclose(plane.row(5), plane0[5], atol=1e-3)
+    np.testing.assert_allclose(plane[table.argbest()],
+                               plane0[t0.argbest()], atol=1e-3)
+    with pytest.raises(TypeError):
+        plane[1:3]
+
+
+def test_spectral_scores_match_host(fdmt_capture):
+    """Shard-local periodicity stage 1 == the host spectral search on the
+    same rows (row-local computation, sharding changes nothing)."""
+    from pulsarutils_tpu.ops.periodicity import spectral_search
+
+    _, plane = fdmt_capture
+    spec = plane.spectral_scores(1e-3, fmin=2.0)
+    host = spectral_search(plane.to_host(), 1e-3, fmin=2.0)
+    np.testing.assert_allclose(spec["freq"], host["freq"], rtol=1e-5)
+    np.testing.assert_allclose(spec["power"], host["power"], rtol=1e-3)
+    np.testing.assert_array_equal(spec["nharm"], host["nharm"])
+    np.testing.assert_allclose(spec["sigma"], host["sigma"], rtol=1e-3)
+
+
+def test_h_curve_per_shard_semantics(fdmt_capture):
+    """The H curve equals the host computation applied per device shard
+    (digitisation stats are per-shard — documented in sharded_plane)."""
+    from pulsarutils_tpu.ops.rebin import quick_resample
+    from pulsarutils_tpu.ops.robust import digitize, h_test_batch
+
+    table, plane = fdmt_capture
+    window = 2
+    h, m = plane.h_curve(window=window)
+    assert h.shape == (len(table["DM"]),)
+
+    # reproduce shard-locally on host: same padded row blocks per device
+    full = np.asarray(plane._plane)  # padded global plane
+    n_dev = plane.mesh.shape[plane.axis]
+    rows_max = full.shape[0] // n_dev
+    t_r = full.shape[1] // window
+    nmax = max(1, t_r // 10)
+    h_ref = np.empty(full.shape[0])
+    for d in range(n_dev):
+        shard = quick_resample(full[d * rows_max:(d + 1) * rows_max], window)
+        counts = np.maximum(digitize(shard), 0)
+        hd, _ = h_test_batch(counts, nmax=nmax)
+        h_ref[d * rows_max:(d + 1) * rows_max] = hd
+    np.testing.assert_allclose(h, h_ref[plane.row_index], rtol=1e-4)
+
+
+def test_decimated_image(fdmt_capture):
+    _, plane = fdmt_capture
+    img, factor = plane.decimated(max_bins=256)
+    assert factor == plane.shape[1] // 256
+    host = plane.to_host()
+    ref = host[:, :256 * factor].reshape(host.shape[0], 256, factor).sum(2)
+    np.testing.assert_allclose(img, ref, atol=1e-2)
+    # no decimation needed when the plane is already small
+    img1, f1 = plane.decimated(max_bins=1 << 20)
+    assert f1 == 1 and img1.shape == plane.shape
+
+
+def test_hybrid_capture_plan_grid(pulse_data):
+    """Hybrid capture returns the coarse plane remapped to the plan grid
+    (same convention as the single-device hybrid's capture)."""
+    from pulsarutils_tpu.ops.search import nearest_rows
+
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    table, plane = sharded_hybrid_search(pulse_data, *ARGS, mesh=mesh,
+                                        capture_plane=True)
+    assert plane.shape[0] == len(table["DM"])
+    # the captured plane is the coarse plane remapped to the plan grid:
+    # reproduce the mapping on the host-gathered single-device coarse
+    # plane over the SAME [dmmin, dmmax] coarse grid.  (The single-device
+    # hybrid's own capture derives its coarse grid from min/max of the
+    # plan grid instead, which can differ by one boundary row — both map
+    # each plan row to its nearest coarse row.)
+    t0, plane0 = dedispersion_search(pulse_data, *ARGS, backend="jax",
+                                     kernel="fdmt", capture_plane=True)
+    idx = nearest_rows(np.asarray(t0["DM"]), np.asarray(table["DM"]))
+    np.testing.assert_allclose(plane.to_host(), np.asarray(plane0)[idx],
+                               atol=1e-3)
+    t1 = dedispersion_search(pulse_data, *ARGS, backend="jax",
+                             kernel="hybrid")
+    b = table.argbest()
+    assert bool(table["exact"][b])
+    assert np.isclose(table["DM"][b], t1["DM"][t1.argbest()])
+
+
+def test_exact_sweep_plane_handle(pulse_data):
+    """plane_handle=True on the exact sharded sweep: device-resident
+    handle equals the host-gathered capture."""
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    t_host, plane_host = sharded_dedispersion_search(
+        pulse_data, *ARGS, mesh=mesh, capture_plane=True)
+    t_dev, handle = sharded_dedispersion_search(
+        pulse_data, *ARGS, mesh=mesh, capture_plane=True,
+        plane_handle=True)
+    np.testing.assert_allclose(handle.to_host(), plane_host, atol=1e-4)
+    np.testing.assert_array_equal(t_host["snr"], t_dev["snr"])
+
+
+def test_period_search_plane_accepts_handle(pulse_data, fdmt_capture):
+    """period_search_plane on the handle == on the gathered plane."""
+    from pulsarutils_tpu.ops.periodicity import period_search_plane
+
+    _, plane = fdmt_capture
+    t = plane.shape[1]
+    kw = dict(fmin=4.0 / (t * 1e-3), refine_top=1)
+    res_mesh = period_search_plane(plane, 1e-3, **kw)
+    res_host = period_search_plane(plane.to_host(), 1e-3, **kw)
+    assert res_mesh["best_dm_index"] == res_host["best_dm_index"]
+    # the handle's spectral stage runs float32 on device vs the host's
+    # float64: the refine grid centre shifts by ~1e-7 relative, so the
+    # refined H/sigma agree to ~1%, not bit-exactly
+    np.testing.assert_allclose(res_mesh["best_freq"], res_host["best_freq"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(res_mesh["best_sigma"],
+                               res_host["best_sigma"], rtol=2e-2)
+
+
+def test_diagnostic_figure_from_handle(pulse_data, fdmt_capture, tmp_path):
+    """The 7-panel figure renders from the sharded handle (H curve and
+    plane image shard-local) and backs the panels with the right data."""
+    from pulsarutils_tpu.pipeline.diagnostics import plot_diagnostics
+    from pulsarutils_tpu.pipeline.pulse_info import PulseInfo
+
+    pytest.importorskip("matplotlib")
+    table, plane = fdmt_capture
+    info = PulseInfo(allprofs=pulse_data, start_freq=1400.0,
+                     bandwidth=300.0, nbin=pulse_data.shape[1],
+                     nchan=pulse_data.shape[0], t0=0.0,
+                     pulse_freq=1.0 / (pulse_data.shape[1] * 1e-3))
+    out = plot_diagnostics(info, table, plane,
+                           outname=str(tmp_path / "mesh_diag.jpg"))
+    import os
+
+    assert os.path.getsize(out) > 0
+
+
+def test_slice_delay_range_still_exact():
+    """Regression guard: the capture refactor must not disturb the
+    slice/stitch bookkeeping the row_index is built from."""
+    slices = slice_delay_range(10, 30, 4)
+    assert slices[0][0] == 10 and slices[-1][1] == 30
+    covered = [n for lo, hi in slices for n in range(lo, hi + 1)]
+    assert covered == list(range(10, 31))
