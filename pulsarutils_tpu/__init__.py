@@ -133,6 +133,9 @@ _LAZY = {
     "certifiable_snr_floor": ("ops.certify", "certifiable_snr_floor"),
     "matched_snr_floor": ("ops.certify", "matched_snr_floor"),
     "expected_noise_max_snr": ("ops.certify", "expected_noise_max_snr"),
+    # certificate miss-risk helpers (round 4, ADVICE r3)
+    "cert_slack_for_miss_p": ("ops.certify", "cert_slack_for_miss_p"),
+    "cert_miss_p_at_floor": ("ops.certify", "cert_miss_p_at_floor"),
 }
 
 

@@ -160,7 +160,7 @@ def h_test_batch(profiles, nmax=20, xp=np, total=None):
     return h, best + 1
 
 
-def digitize(data, xp=np):
+def digitize(data, xp=np, center=None, scale=None):
     """Scale data to non-negative integer counts for event statistics.
 
     ``rint(clip((x - median) / MAD * 3, 0, inf))`` — reference
@@ -168,11 +168,17 @@ def digitize(data, xp=np):
     integer input passes through (the reference's ``isinstance(data,
     np.int)`` check could never fire for arrays), and the MAD is a *global*
     scalar rather than statsmodels' silent per-column axis-0 reduction.
+
+    ``center``/``scale`` override the internally computed median/MAD —
+    for callers whose array carries rows that must not contaminate the
+    stats (the DM-sharded plane's SPMD pad rows,
+    :meth:`~pulsarutils_tpu.parallel.sharded_plane.ShardedPlane.h_curve`).
     """
     data = xp.asarray(data)
     if np.issubdtype(np.dtype(str(data.dtype)), np.integer):
         return data
-    std = mad(data, xp=xp)
-    scaled = (data - xp.median(data)) / std * 3.0
+    std = mad(data, xp=xp) if scale is None else scale
+    med = xp.median(data) if center is None else center
+    scaled = (data - med) / std * 3.0
     scaled = xp.where(scaled < 0, 0.0, scaled)
     return xp.rint(scaled).astype(xp.int32)

@@ -478,8 +478,9 @@ HYBRID_MAX_ROUNDS = 20
 #: optimistic at the worst phase and is corrected here.  This constant
 #: is only the FALLBACK for callers that do not supply the sliding
 #: certificate scores; the hybrid itself now uses the per-config
-#: phase-invariant bound (``certify.cert_retention``), which is both
-#: rigorous and tighter (~0.56 retention).
+#: phase-invariant bound (``certify.cert_retention``) — computed rather
+#: than hand-set, and tighter (~0.56 retention; sound up to the noise
+#: cross-term, see certify's *Miss risk* section).
 HYBRID_COARSE_TRUST = 0.60
 
 
@@ -519,7 +520,8 @@ def nearest_rows(sorted_grid, targets):
 
 def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
                           snr_floor=None, seed_done=False,
-                          cert_scores=None, rho_cert=None):
+                          cert_scores=None, rho_cert=None,
+                          cert_slack=None):
     """The hybrid's seed + guarantee iteration (see
     :func:`_search_jax_hybrid` for the full rationale).
 
@@ -527,26 +529,37 @@ def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
 
     With ``cert_scores``/``rho_cert`` supplied (the sliding certificate
     row and the per-config retention bound, :mod:`.certify`), the loop
-    uses the RIGOROUS skip proof: row ``j`` is left unrescored only when
-    ``(cert_j + HYBRID_CERT_SLACK) / rho_cert < best_exact`` — an
-    impulsive signal beating the exact best would necessarily show a
-    certificate score above that line, so skipped rows provably cannot
-    hold the best hit.  This replaces the round-2 heuristic margins
-    (1.5x the *observed* underestimate — a peak-biased sample — and the
-    hand-set :data:`HYBRID_COARSE_TRUST` fraction), which the round-3
-    worst-case analysis showed could in principle skip a worst-phase
-    width-1 pulse.  Consequence worth knowing: on chunks whose best is
-    barely above the noise (no certificate, no bright pulse) the
-    rigorous criterion rescans honestly toward a full exact sweep — the
-    noise-certificate fast path, not the margin, is what makes
-    signal-free chunks cheap.
+    uses the cert-based skip criterion: row ``j`` is left unrescored
+    only when ``(cert_j + HYBRID_CERT_SLACK) / rho_cert < best_exact``
+    — an impulsive signal beating the exact best would show a
+    certificate score above that line, so skipped rows cannot hold the
+    best hit *under the stated signal model, up to the Gaussian noise
+    cross-term the slack absorbs* (sd <= 1 S/N unit; at the default
+    slack an at-worst-phase row whose true S/N exactly ties the best
+    retains a ``Phi(-0.5)`` ~ 31% chance of evading rescoring — see
+    :mod:`.certify`'s *Miss risk* section; the probability collapses as
+    the true gap grows, and such a tie is score-equivalent anyway).
+    This replaces the round-2 heuristic margins (1.5x the *observed*
+    underestimate — a peak-biased sample — and the hand-set
+    :data:`HYBRID_COARSE_TRUST` fraction), which the round-3 worst-case
+    analysis showed could in principle skip a worst-phase width-1
+    pulse deterministically.  Consequence worth knowing: on chunks
+    whose best is barely above the noise (no certificate, no bright
+    pulse) the cert-based criterion rescans honestly toward a full
+    exact sweep — the noise-certificate fast path, not the margin, is
+    what makes signal-free chunks cheap.
 
     Without cert scores the legacy margins apply (conservative fallback
     for callers that only have block coarse scores).  ``seed_done=True``
     skips the seeding round (the fused TPU program already rescored it).
+    ``cert_slack`` overrides :data:`~.certify.HYBRID_CERT_SLACK` in the
+    skip criterion (derive it from a target miss probability with
+    :func:`~.certify.cert_slack_for_miss_p`).
     """
     from .certify import HYBRID_CERT_SLACK
 
+    if cert_slack is None:
+        cert_slack = HYBRID_CERT_SLACK
     ndm = len(coarse_snrs)
     if not seed_done:
         seed = (coarse_snrs >= coarse_snrs.max() - 0.5)
@@ -556,12 +569,12 @@ def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
         grown = np.unique(np.clip(seed_idx[:, None]
                                   + np.arange(-1, 2)[None, :], 0, ndm - 1))
         rescore(grown)
-    rigorous = cert_scores is not None and rho_cert is not None
+    cert_based = cert_scores is not None and rho_cert is not None
     for _round in range(HYBRID_MAX_ROUNDS):
         best_exact = snrs[exact].max()
-        if rigorous:
+        if cert_based:
             need = (~exact) & (cert_scores
-                               >= rho_cert * best_exact - HYBRID_CERT_SLACK)
+                               >= rho_cert * best_exact - cert_slack)
             # consistency guard (mirrors certify_noise_only's): a row
             # whose DISPLAYED coarse block score already beats the exact
             # best must be rescored even if its sliding cert score is
@@ -571,7 +584,7 @@ def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
             need |= (~exact) & (coarse_snrs >= best_exact)
             if snr_floor is not None:
                 need |= (~exact) & (cert_scores >= rho_cert * snr_floor
-                                    - HYBRID_CERT_SLACK)
+                                    - cert_slack)
                 # same consistency guard for the floor contract: a row
                 # DISPLAYING an above-floor coarse score must be exact
                 need |= (~exact) & (coarse_snrs >= snr_floor)
@@ -600,7 +613,8 @@ def hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
 def hybrid_certificate_gate(cert_scores, coarse_snrs, snrs, exact, rescore,
                             *, nchan, trial_dms, start_freq, bandwidth,
                             sample_time, nsamples, snr_floor,
-                            noise_certificate, seed_done=False):
+                            noise_certificate, seed_done=False,
+                            rho_cert=None, cert_slack=None):
     """The certificate check + guarantee loop, shared VERBATIM by the
     single-device and sharded hybrids (their docstrings promise an
     identical contract — this helper is what makes that true).
@@ -616,35 +630,51 @@ def hybrid_certificate_gate(cert_scores, coarse_snrs, snrs, exact, rescore,
 
     Otherwise computes the per-config retention bound, certifies the
     chunk signal-free when permitted (skipping the loop entirely), and
-    runs :func:`hybrid_guarantee_loop` with the rigorous cert-based
-    skip proof.  Returns ``(certified, rho_cert_min)`` —
-    ``rho_cert_min`` is ``None`` on padded runs.
+    runs :func:`hybrid_guarantee_loop` with the cert-based skip
+    criterion (sound under the stated signal model up to the Gaussian
+    noise cross-term — :mod:`.certify`, *Miss risk*).  Returns
+    ``(certified, rho_cert_min)`` — ``rho_cert_min`` is ``None`` on
+    padded runs.
+
+    ``rho_cert`` pre-empts the bound computation: a float is used
+    verbatim (callers cycling many distinct geometries can precompute
+    ``certify.cert_retention(...).min()`` off the hot path — the
+    first-call cost is multi-second at multi-thousand-trial configs,
+    lru-cached per config afterwards); ``False`` opts out of the
+    cert-based machinery entirely, dropping the loop to the legacy
+    conservative margins (no certificate, no bound computation).
+    ``cert_slack`` overrides the default
+    :data:`~.certify.HYBRID_CERT_SLACK` in both the certificate
+    threshold and the skip criterion.
     """
     import jax
 
     from .certify import certify_noise_only, retention_bound
     from .fdmt import _pick_fdmt_tile
 
-    if (jax.default_backend() == "tpu"
-            and _pick_fdmt_tile(int(nsamples)) == 0):
+    if rho_cert is False or (jax.default_backend() == "tpu"
+                             and _pick_fdmt_tile(int(nsamples)) == 0):
         cert_scores = None
         noise_certificate = False
 
     rho_cert_min = None
     certified = False
     if cert_scores is not None:
-        rho_cert_min = retention_bound(nchan, trial_dms, start_freq,
-                                       bandwidth, sample_time, nsamples,
-                                       cert=True)
+        rho_cert_min = (float(rho_cert) if rho_cert is not None
+                        else retention_bound(nchan, trial_dms, start_freq,
+                                             bandwidth, sample_time,
+                                             nsamples, cert=True))
         certified = bool(noise_certificate
                          and certify_noise_only(cert_scores, snr_floor,
                                                 rho_cert_min,
-                                                coarse_snrs=coarse_snrs))
+                                                coarse_snrs=coarse_snrs,
+                                                slack=cert_slack))
     if not certified:
         hybrid_guarantee_loop(coarse_snrs, snrs, exact, rescore,
                               snr_floor=snr_floor, seed_done=seed_done,
                               cert_scores=cert_scores,
-                              rho_cert=rho_cert_min)
+                              rho_cert=rho_cert_min,
+                              cert_slack=cert_slack)
     return certified, rho_cert_min
 
 
@@ -759,7 +789,8 @@ def _fused_rescore_kernel(max_off, dm_block):
 
 def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
                        capture_plane, dm_block, chan_block,
-                       snr_floor=None, noise_certificate=True):
+                       snr_floor=None, noise_certificate=True,
+                       rho_cert=None, cert_slack=None):
     """FDMT coarse sweep + exact rescore of the hit region.
 
     The throughput/exactness trade (VERDICT round 1): the FDMT computes
@@ -798,12 +829,15 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
     *all* above-threshold detections exact, not just the best — and,
     with ``noise_certificate`` (default on), enabling the noise
     certificate: when NO trial's certificate score reaches
-    ``rho_cert * snr_floor - HYBRID_CERT_SLACK``, the chunk provably
-    holds no impulsive signal detectable at the floor, the guarantee
-    loop is skipped entirely, and the coarse table is returned with
-    ``meta["certified"] = True`` (its rows are then coarse scores, NOT
-    exact — the certificate's claim is strictly the absence of
-    detections).  On survey data this is the difference between the
+    ``rho_cert * snr_floor - HYBRID_CERT_SLACK``, the chunk holds no
+    impulsive signal detectable at the floor (sound under the stated
+    signal model up to the Gaussian noise cross-term the slack absorbs
+    — residual at-floor miss risk recorded in
+    ``meta["cert_miss_p_at_floor"]``, see :mod:`.certify` *Miss risk*),
+    the guarantee loop is skipped entirely, and the coarse table is
+    returned with ``meta["certified"] = True`` (its rows are then
+    coarse scores, NOT exact — the certificate's claim is strictly the
+    absence of detections).  On survey data this is the difference between the
     hybrid degenerating to a full exact sweep on every signal-free
     chunk and paying one tree transform per such chunk.  Note the floor
     must sit at ``certify.certifiable_snr_floor`` (~12 at 1M-sample
@@ -966,14 +1000,15 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         w = np.rint(w).astype(np.int32)
         p = (np.rint(p).astype(np.int64) - roll_k) % nsamples
         _apply(sel, (m, s, b_, w, p))
-    # the rigorous cert-based criterion covers the snr_floor rows
-    # directly (every row that could hold an above-floor detection is
-    # flagged per-row), so no separate floor pre-pass is needed
+    # the cert-based criterion covers the snr_floor rows directly
+    # (every row that could hold an above-floor detection is flagged
+    # per-row), so no separate floor pre-pass is needed
     certified, rho_cert_min = hybrid_certificate_gate(
         cert_scores, coarse_snrs, snrs, exact, rescore, nchan=nchan,
         trial_dms=trial_dms, start_freq=start_freq, bandwidth=bandwidth,
         sample_time=sample_time, nsamples=nsamples, snr_floor=snr_floor,
-        noise_certificate=noise_certificate, seed_done=fused_seed)
+        noise_certificate=noise_certificate, seed_done=fused_seed,
+        rho_cert=rho_cert, cert_slack=cert_slack)
     logger.debug("hybrid: %d/%d rows rescored exactly%s", exact.sum(), ndm,
                  " (noise-certified)" if certified else "")
 
@@ -989,7 +1024,8 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                         show=False, *, backend="numpy", capture_plane=None,
                         trial_dms=None, dm_block=None, chan_block=None,
                         dtype=None, kernel="auto", snr_floor=None,
-                        noise_certificate=True):
+                        noise_certificate=True, rho_cert=None,
+                        cert_slack=None):
     """Sweep trial DMs over ``data`` and score each dedispersed series.
 
     Parameters mirror the reference façade
@@ -1013,7 +1049,28 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         :func:`_search_jax_hybrid`.
     noise_certificate : ``kernel="hybrid"`` with ``snr_floor`` only —
         allow the certified fast path on signal-free chunks (default
-        on); the verdict lands in ``table.meta["certified"]``.
+        on); the verdict lands in ``table.meta["certified"]``, with the
+        certificate's operating assumptions (``cert_slack``,
+        ``cert_miss_p_at_floor`` — see :mod:`.certify` *Miss risk*)
+        alongside.
+    rho_cert : ``kernel="hybrid"`` only — the per-config certificate
+        retention bound.  ``None`` (default) computes it from the
+        transform's merge tables; NOTE this is a multi-second host
+        computation on the FIRST call at a multi-thousand-trial config
+        (lru-cached per config afterwards, 32 entries).  Pass a
+        precomputed ``certify.cert_retention(...).min()`` to move that
+        cost off the hot path (one-shot calls at large configs,
+        workloads cycling > 32 geometries), or ``False`` to skip the
+        certificate machinery entirely (the guarantee loop then uses
+        the legacy conservative margins — still exact-argbest, no
+        certified fast path).
+    cert_slack : ``kernel="hybrid"`` only — override the certificate
+        slack (default :data:`~.certify.HYBRID_CERT_SLACK`).  Derive it
+        from a target at-floor miss probability with
+        :func:`~.certify.cert_slack_for_miss_p`; a larger slack
+        tightens the miss risk at the cost of a higher
+        :func:`~.certify.certifiable_snr_floor` and more rescoring.
+        The value used is recorded in ``meta["cert_slack"]``.
     kernel : JAX-path kernel selector: ``"auto"`` (Pallas on TPU, gather
         elsewhere), ``"pallas"`` (hand-written tiled TPU kernel, see
         :mod:`.pallas_dedisperse`), ``"gather"`` (portable XLA
@@ -1081,13 +1138,17 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
 
         if dtype not in (None, _jnp.float32):
             raise ValueError("kernel='hybrid' supports float32 only")
+        from .certify import cert_meta
+
         (maxvalues, stds, best_snrs, best_windows, best_peaks, exact,
          plane, cert_scores, certified,
-         rho_cert) = _search_jax_hybrid(data, trial_dms, start_freq,
-                                        bandwidth, sample_time,
-                                        capture_plane, dm_block,
-                                        chan_block, snr_floor=snr_floor,
-                                        noise_certificate=noise_certificate)
+         rho_out) = _search_jax_hybrid(data, trial_dms, start_freq,
+                                       bandwidth, sample_time,
+                                       capture_plane, dm_block,
+                                       chan_block, snr_floor=snr_floor,
+                                       noise_certificate=noise_certificate,
+                                       rho_cert=rho_cert,
+                                       cert_slack=cert_slack)
         table = ResultTable({
             "DM": trial_dms,
             "max": maxvalues,
@@ -1097,8 +1158,11 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
             "peak": best_peaks,
             "exact": exact,
             "cert": cert_scores,
-        }, meta={"certified": certified, "rho_cert": rho_cert,
-                 "snr_floor": snr_floor})
+            # meta records the certificate's operating assumptions
+            # wherever its verdict is (ADVICE r3): the slack is a
+            # z-score against the Gaussian noise cross-term, not a hard
+            # bound — see certify's *Miss risk* section
+        }, meta=cert_meta(certified, rho_out, snr_floor, cert_slack))
         return (table, plane) if (capture_plane or show) else table
 
     if backend == "numpy":

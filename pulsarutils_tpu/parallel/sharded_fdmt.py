@@ -266,7 +266,8 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
 
 def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
                           sample_time, mesh, snr_floor=None,
-                          noise_certificate=True, capture_plane=False):
+                          noise_certificate=True, capture_plane=False,
+                          rho_cert=None, cert_slack=None):
     """Hybrid (exact hits at coarse cost) over a ``(dm, chan)`` mesh.
 
     Multi-device composition of ``dedispersion_search(kernel="hybrid")``:
@@ -274,22 +275,30 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     idle/replicated there — use ``chan=1`` meshes when the coarse stage
     dominates), and the exact rescore of candidate rows runs through
     :func:`~pulsarutils_tpu.parallel.sharded.sharded_dedispersion_search`
-    over the full mesh.  The guarantee loop, the rigorous cert-based
-    skip proof and the noise certificate are shared with the
+    over the full mesh.  The guarantee loop, the cert-based skip
+    criterion and the noise certificate are shared with the
     single-device hybrid (:mod:`~pulsarutils_tpu.ops.certify`), so the
     contract is identical: the returned argbest row holds the exact
     kernel's scores (unless ``meta["certified"]``, which asserts no
-    detection above ``snr_floor`` exists), with an ``exact`` column
-    marking exact rows.
+    detection above ``snr_floor`` exists — sound under the stated
+    signal model up to the Gaussian noise cross-term, residual risk in
+    ``meta["cert_miss_p_at_floor"]``), with an ``exact`` column marking
+    exact rows.
 
     ``capture_plane`` returns ``(table, plane)`` with ``plane`` a
     :class:`~.sharded_plane.ShardedPlane` over the *coarse* (FDMT) plane
     remapped to the plan grid — the same coarse-plane convention as the
     single-device hybrid's capture (``ops/search.py``:
     ``_search_jax_hybrid``), kept DM-sharded and device-resident.
+
+    ``rho_cert`` / ``cert_slack`` mirror ``dedispersion_search``'s
+    knobs: a precomputed retention bound (or ``False`` to opt out of
+    the cert machinery) and a certificate slack derived from a target
+    miss probability (:func:`~pulsarutils_tpu.ops.certify.cert_slack_for_miss_p`).
     """
     import jax.numpy as jnp
 
+    from ..ops.certify import cert_meta
     from ..ops.plan import dedispersion_plan
     from ..ops.search import (
         hybrid_certificate_gate,
@@ -344,7 +353,8 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         cert_scores, coarse_snrs, snrs, exact, rescore, nchan=nchan,
         trial_dms=trial_dms, start_freq=start_freq, bandwidth=bandwidth,
         sample_time=sample_time, nsamples=nsamples, snr_floor=snr_floor,
-        noise_certificate=noise_certificate)
+        noise_certificate=noise_certificate, rho_cert=rho_cert,
+        cert_slack=cert_slack)
     table = ResultTable({
         "DM": trial_dms,
         "max": maxvalues,
@@ -354,6 +364,5 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         "peak": peaks,
         "exact": exact,
         "cert": cert_scores,
-    }, meta={"certified": certified, "rho_cert": rho_cert_min,
-             "snr_floor": snr_floor})
+    }, meta=cert_meta(certified, rho_cert_min, snr_floor, cert_slack))
     return (table, plane) if capture_plane else table

@@ -15,10 +15,11 @@ all reduce over the time axis only), so sharding the row axis changes
 nothing numerically — with ONE documented exception: :meth:`ShardedPlane.
 h_curve`'s count digitisation (:func:`~pulsarutils_tpu.ops.robust.digitize`)
 normalises by the plane's median/MAD, which here is computed per device
-shard rather than globally.  On renormalised survey data the shards are
-statistically identical so the curves agree closely, but they are not
-bit-equal to the single-device curve (the tests pin the per-shard
-semantics instead).
+shard rather than globally (over the shard's valid rows only — SPMD pad
+rows are masked out of the stats).  On renormalised survey data the
+shards are statistically identical so the curves agree closely, but they
+are not bit-equal to the single-device curve (the tests pin the
+per-shard semantics instead).
 """
 
 from __future__ import annotations
@@ -72,23 +73,30 @@ def _h_program(mesh, axis, window, nmax):
     (reference ``clean.py:252-255``) on the device shard: resample by the
     candidate's boxcar window, digitise to counts, batched H-test.  The
     digitisation stats (median/MAD) are per-shard — see the module
-    docstring.
+    docstring — and are computed over the shard's VALID rows only
+    (``valid`` masks out the plane's SPMD pad rows via the NaN-median
+    trick; FDMT transform scratch and duplicated edge-pad trials are
+    not guaranteed benign on every kernel path, code-review r4).
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from ..ops.rebin import quick_resample
-    from ..ops.robust import digitize, h_test_batch
+    from ..ops.robust import MAD_SCALE, digitize, h_test_batch
 
-    def local(rows):
+    def local(rows, valid):
         r = quick_resample(rows, window, xp=jnp) if window > 1 else rows
-        counts = jnp.maximum(digitize(r, xp=jnp), 0)
+        masked = jnp.where(valid[:, None], r, jnp.nan)
+        med = jnp.nanmedian(masked)
+        scale = jnp.nanmedian(jnp.abs(masked - med)) / MAD_SCALE
+        counts = jnp.maximum(
+            digitize(r, xp=jnp, center=med, scale=scale), 0)
         h, m = h_test_batch(counts, nmax=nmax, xp=jnp)
         return h.astype(jnp.float32), m.astype(jnp.int32)
 
     return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(P(axis, None),),
+                                 in_specs=(P(axis, None), P(axis)),
                                  out_specs=(P(axis), P(axis))))
 
 
@@ -191,7 +199,11 @@ class ShardedPlane:
             nmax = max(1, t_r // 10)
         nmax = int(max(1, min(nmax, t_r // 2 if t_r >= 4 else 1)))
         run = _h_program(self.mesh, self.axis, int(window), nmax)
-        h, m = run(self._plane)
+        import jax.numpy as jnp
+
+        valid = np.zeros(int(self._plane.shape[0]), dtype=bool)
+        valid[np.unique(self.row_index)] = True
+        h, m = run(self._plane, jnp.asarray(valid))
         return (np.asarray(h)[self.row_index],
                 np.asarray(m)[self.row_index])
 

@@ -195,14 +195,20 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             and exact_floor != "auto":
         raise ValueError(f"exact_floor={exact_floor!r}: expected True, "
                          "False or 'auto'")
-    if mesh is not None and not {"dm", "chan"} <= set(mesh.shape):
+    if mesh is not None:
         # fail fast: a missing axis would otherwise surface as a KeyError
         # inside the first chunk's search, which the failure-containment
         # path misreads as a transient device fault and silently retries
-        # into the numpy fallback
-        raise ValueError(
-            f"mesh axes {tuple(mesh.shape)} must include 'dm' and 'chan' "
-            "(build one with make_mesh((d, c), ('dm', 'chan')))")
+        # into the numpy fallback.  kernel="fdmt" routes to the DM-sliced
+        # sharded FDMT only, so a dm-only mesh is valid there; every
+        # other kernel reaches sharded_dedispersion_search, which indexes
+        # both axes.
+        needed = {"dm"} if kernel == "fdmt" else {"dm", "chan"}
+        if not needed <= set(mesh.shape):
+            raise ValueError(
+                f"mesh axes {tuple(mesh.shape)} must include "
+                f"{sorted(needed)} for kernel={kernel!r} (build one with "
+                "make_mesh((d, c), ('dm', 'chan')))")
     logger.info("opening %s", fname)
     # strip only the final extension: "obs.day1.fil" and "obs.day2.fil"
     # must keep distinct candidate roots in a shared output directory
@@ -268,7 +274,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         if snr_threshold == "auto":
             ndm = len(dedispersion_plan(header["nchans"], dmmin, dmmax,
                                         start_freq, bandwidth, eff_tsamp))
-            snr_threshold = matched_snr_floor(t_eff, ndm)
+            # clamped to the reference default (clean.py:349): at short
+            # chunks the matched floor resolves BELOW 6 and "auto" must
+            # never be more permissive than the reference's criterion
+            # (the Gumbel fit is also least validated at small m —
+            # certify.expected_noise_max_snr's stated fit domain)
+            snr_threshold = max(matched_snr_floor(t_eff, ndm), 6.0)
         elif snr_threshold == "certifiable":
             snr_threshold = _chunk_cert_floor()
         else:
@@ -447,9 +458,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             best = table.best_row()
             is_hit = bool(best["snr"] > snr_threshold)
             if getattr(table, "meta", {}).get("certified"):
-                # hybrid noise certificate: the chunk provably holds no
-                # detection above snr_threshold (so is_hit is False by
-                # construction) and no exact rescoring was paid
+                # hybrid noise certificate: the chunk holds no detection
+                # above snr_threshold (up to the certificate's stated
+                # miss risk, table.meta["cert_miss_p_at_floor"] — so
+                # is_hit is False by construction) and no exact
+                # rescoring was paid
                 ncertified += 1
 
             if period_search and plane is not None:

@@ -451,6 +451,31 @@ def test_search_by_chunks_mesh(pulse_file, tmp_path):
     assert np.isclose(best[2].dm, best1[2].dm, atol=1e-6)
 
 
+def test_search_by_chunks_mesh_dm_only_fdmt(pulse_file, tmp_path):
+    """kernel='fdmt' routes to the DM-sliced sharded FDMT only, so a
+    dm-only mesh is a valid configuration (the axes fail-fast guard must
+    not reject it — code-review r4); other kernels still need 'chan'."""
+    import jax
+
+    from pulsarutils_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    path, pulse_t = pulse_file
+    mesh = make_mesh((8,), ("dm",))
+    hits, _ = search_by_chunks(
+        path, dmmin=100, dmmax=200, backend="jax", kernel="fdmt",
+        mesh=mesh, output_dir=str(tmp_path), make_plots=False,
+        snr_threshold=6.0, resume=False,
+        tmin=8000 * 0.0005, max_chunks=6)
+    assert any(istart <= pulse_t < iend for istart, iend, _, _ in hits)
+    with pytest.raises(ValueError, match="mesh axes"):
+        search_by_chunks(path, dmmin=100, dmmax=200, backend="jax",
+                         kernel="hybrid", mesh=mesh,
+                         output_dir=str(tmp_path), make_plots=False,
+                         resume=False, max_chunks=1)
+
+
 def test_search_by_chunks_mesh_plane_products(pulse_file, tmp_path):
     """VERDICT r3 #1: plane products work under mesh= — the scaled-out
     path is no longer a capability subset.  Diagnostics and the period
@@ -510,14 +535,23 @@ def test_search_by_chunks_mesh_period_search(pulsar_file, tmp_path):
     assert info.fold_profile is not None
 
 
-def test_snr_threshold_auto_resolves(pulse_file, tmp_path):
+def test_snr_threshold_auto_resolves(pulse_file, tmp_path, caplog):
+    import logging
+    import re
+
     path, pulse_t = pulse_file
-    hits, _ = search_by_chunks(
-        path, dmmin=100, dmmax=200, backend="jax",
-        output_dir=str(tmp_path), make_plots=False,
-        snr_threshold="auto", resume=False, max_chunks=3)
-    # resolves to a number without error; the floor sits above the
-    # fixed reference default only when chunks are long enough
+    with caplog.at_level(logging.INFO, logger="pulsarutils_tpu"):
+        hits, _ = search_by_chunks(
+            path, dmmin=100, dmmax=200, backend="jax",
+            output_dir=str(tmp_path), make_plots=False,
+            snr_threshold="auto", resume=False, max_chunks=3)
+    # resolves to a number without error, clamped to the reference
+    # default 6.0 (ADVICE r3: "auto" must never be MORE permissive than
+    # the reference's fixed criterion at short chunks)
+    resolved = [m for r in caplog.records
+                for m in re.findall(r"snr_threshold resolved to ([\d.]+)",
+                                    r.getMessage())]
+    assert resolved and float(resolved[0]) >= 6.0
     with pytest.raises(ValueError, match="snr_threshold"):
         search_by_chunks(path, dmmin=100, dmmax=200,
                          output_dir=str(tmp_path), make_plots=False,
