@@ -136,6 +136,8 @@ _LAZY = {
     # certificate miss-risk helpers (round 4, ADVICE r3)
     "cert_slack_for_miss_p": ("ops.certify", "cert_slack_for_miss_p"),
     "cert_miss_p_at_floor": ("ops.certify", "cert_miss_p_at_floor"),
+    # disk-backed plane capture (round 4)
+    "plane_memmap": ("ops.search", "plane_memmap"),
 }
 
 

@@ -64,9 +64,7 @@ def _build_library():
             # half-written (yet ELF-parsable) library
             tmp = f"{out}.tmp{os.getpid()}"
             try:
-                cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
-                subprocess.run(cmd, check=True, capture_output=True,
-                               timeout=120)
+                _compile(tmp)
                 os.replace(tmp, out)
             finally:
                 if os.path.exists(tmp):  # failed build: no orphan files
@@ -74,7 +72,33 @@ def _build_library():
             return out
         except (OSError, subprocess.SubprocessError) as exc:
             logger.debug("native unpack build failed in %s: %s", d, exc)
+    logger.info("native low-bit unpacker unavailable (no working C++ "
+                "toolchain); using the numpy fallback — correct but "
+                "slower on multi-GB low-bit files")
     return None
+
+
+def _compile(out):
+    """Build ``unpack.cpp`` with the first working compiler.
+
+    ``$CXX`` wins when set; otherwise g++ then clang++ then c++ — on
+    macOS ``g++`` is usually a clang shim and all three take the same
+    ``-shared -fPIC`` flags (the library is self-contained, so no
+    ``-undefined dynamic_lookup`` is needed).  Raises the last failure
+    when none work (the caller logs and falls back to numpy).
+    """
+    compilers = ([os.environ["CXX"]] if os.environ.get("CXX")
+                 else ["g++", "clang++", "c++"])
+    last = None
+    for cxx in compilers:
+        try:
+            subprocess.run([cxx, "-O3", "-shared", "-fPIC", "-o", out,
+                            _SRC], check=True, capture_output=True,
+                           timeout=120)
+            return
+        except (OSError, subprocess.SubprocessError) as exc:
+            last = exc
+    raise last
 
 
 def _load():

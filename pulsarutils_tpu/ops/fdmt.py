@@ -600,13 +600,16 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                                 n_lo, t):
         from .fdmt_resident import (
             HEAD_LEVELS,
-            HEAD_T_SLICE,
             _build_head_kernel,
+            _head_plan_cached,
+            pick_head_t_slice,
         )
 
+        hp = _head_plan_cached(nchan, start_freq, bandwidth, max_delay,
+                               n_lo, HEAD_LEVELS)
         head_run, _ = _build_head_kernel(
             nchan, start_freq, bandwidth, max_delay, n_lo,
-            HEAD_LEVELS, t, HEAD_T_SLICE, interpret)
+            HEAD_LEVELS, t, pick_head_t_slice(hp, t), interpret)
         n_head = HEAD_LEVELS
 
     def fn(data):

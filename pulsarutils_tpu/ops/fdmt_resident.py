@@ -177,10 +177,65 @@ def _head_plan_cached(nchan, start_freq, bandwidth, max_delay, min_delay,
                               min_delay), n_levels)
 
 
+#: VMEM budget (bytes) for the head's two ping-pong scratch buffers —
+#: the chip's ~16 MB VMEM minus headroom for the small DMA staging and
+#: compiler temporaries (t_slice = 8192 at the benchmark plan lands at
+#: 12.6 MB; 16384 would need 21 MB and is rejected)
+_VMEM_BUDGET = 14 << 20
+
+
+def _head_geometry(head, t_slice):
+    """Derived sizes for one (plan, t_slice): chunks allocated per step
+    and the scratch rows — shared by the builder and the slice chooser."""
+    # level-0 input must stay valid over t_slice + halo; +1 chunk so the
+    # 16-row shifted loads (8 rows past a chunk's base) never run off
+    chunks_alloc = -(-(t_slice + head.halo) // _CHUNK) + 1
+    rows_buf = max([head.rows_in] + head.rows_out)
+    return chunks_alloc, rows_buf
+
+
+def pick_head_t_slice(head, t):
+    """Largest power-of-two time slice whose scratch fits VMEM.
+
+    Bigger slices amortise the head's halo recompute (every non-final
+    level computes ``ceil((t_slice + halo)/CHUNK)`` chunks for
+    ``t_slice/CHUNK`` useful ones: 2-for-1 at 2048 with the benchmark's
+    148-sample halo, 5-for-4 at 8192) and cut the per-step grid
+    overhead — measured 0.232 s -> 0.146 s head-only at the 1024 x 1M
+    benchmark.  The ceiling is the two ping-pong buffers' VMEM
+    footprint (:data:`_VMEM_BUDGET`); the floor is the eligibility
+    t_slice (:data:`HEAD_T_SLICE`), which callers have already checked
+    divides T.
+    """
+    for t_slice in (16384, 8192, 4096, 2048):
+        if t_slice < HEAD_T_SLICE or t % t_slice or t_slice % _CHUNK:
+            continue
+        if head.halo > (2 * t_slice) // 3:
+            continue
+        chunks_alloc, rows_buf = _head_geometry(head, t_slice)
+        if 2 * rows_buf * chunks_alloc * _CHUNK * 4 <= _VMEM_BUDGET:
+            return t_slice
+    return HEAD_T_SLICE
+
+
 @functools.lru_cache(maxsize=8)
 def _build_head_kernel(nchan, start_freq, bandwidth, max_delay, min_delay,
                        n_levels, t, t_slice, interpret):
-    """Compile the fused-head pallas program for one (plan, T) config."""
+    """Compile the fused-head pallas program for one (plan, T) config.
+
+    I/O is MANUAL DMA (``ANY``-space operands + ``make_async_copy``)
+    rather than pipelined BlockSpecs: the pipelined form double-buffers
+    ``k_in`` whole input slices in VMEM, which at t_slice > 2048 blew
+    the ~16 MB VMEM (measured: every (t_slice >= 4096 | levels >= 8)
+    combination failed to compile).  Manual copies stage exactly the
+    ``chunks_alloc`` chunks a step needs, un-double-buffered — the DMA
+    is ~microseconds against a ~200 us compute step, so the lost
+    overlap is noise and the freed VMEM buys the big-slice win
+    (:func:`pick_head_t_slice`).  The circular wrap is handled by
+    statically-unrolled per-step copy segments (DMA shapes must be
+    static; only the last few steps wrap and each split is a
+    compile-time constant).
+    """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -194,33 +249,12 @@ def _build_head_kernel(nchan, start_freq, bandwidth, max_delay, min_delay,
     assert max(head.max_shift_per_level) < _L, head.max_shift_per_level
     n_slices = t // t_slice
     cpb = t_slice // _CHUNK          # (8, L) chunks per slice
-    # input window must cover t_slice + head halo; at n_slices == 1 the
-    # staggered (i_s + k) % n_slices maps all fetch slice 0 — which IS
-    # the circular wrap for T == t_slice, so no special case (an early
-    # `else 1` here left the halo region of the buffer unstitched and
-    # the last `halo` output samples read uninitialised VMEM)
-    k_in = -(-(t_slice + head.halo) // t_slice)
-    # chunk extents: level lev's input must stay valid over
-    # t_slice + remaining_halo(lev); +1 chunk so the 16-row loads (8
-    # rows past a chunk's base) never run off the buffer
-    chunks_alloc = max(-(-(t_slice + head.halo) // _CHUNK),
-                       k_in * cpb) + 1
+    chunks_alloc, rows_buf = _head_geometry(head, t_slice)
     r_alloc = chunks_alloc * 8
-    rows_buf = max([head.rows_in] + head.rows_out)
+    c8 = n_slices * cpb * 8          # time axis in 8-row units
+    rows_final = head.rows_out[-1]
 
     grid = (head.n_groups, n_slices)
-
-    # index maps receive the scalar-prefetch refs after the grid indices
-    in_specs = [
-        pl.BlockSpec((head.rows_in, cpb, 8, _L),
-                     functools.partial(
-                         lambda g, i_s, *_tabs, _k: (g, (i_s + _k)
-                                                     % n_slices, 0, 0),
-                         _k=k))
-        for k in range(k_in)
-    ]
-    out_spec = pl.BlockSpec((head.rows_out[-1], cpb, 8, _L),
-                            lambda g, i_s, *_tabs: (g, i_s, 0, 0))
 
     n_chunks_out = [-(-(t_slice + head.remaining_halo(lev + 1)) // _CHUNK)
                     for lev in range(n_levels)]
@@ -229,19 +263,63 @@ def _build_head_kernel(nchan, start_freq, bandwidth, max_delay, min_delay,
     def kernel(*args):
         # scalar prefetch: 4 tables per level, each (n_groups, rows_max)
         tabs = args[:4 * n_levels]
-        in_refs = args[4 * n_levels:4 * n_levels + k_in]
-        out_ref = args[4 * n_levels + k_in]
-        buf_a = args[4 * n_levels + k_in + 1]
-        buf_b = args[4 * n_levels + k_in + 2]
+        data_hbm = args[4 * n_levels]       # (rows, c8, L) in ANY space
+        out_hbm = args[4 * n_levels + 1]    # (G*rows_final, c8, L) in ANY
+        buf_a, buf_b, sem_in, sem_out = args[4 * n_levels + 2:]
 
         g = pl.program_id(0)
+        i_s = pl.program_id(1)
         lane = jax.lax.broadcasted_iota(jnp.int32, (8, _L), 1)
 
-        # stitch the staggered input blocks into the level-0 buffer
-        for k in range(k_in):
-            for j in range(cpb):
-                buf_a[:head.rows_in,
-                      pl.ds((k * cpb + j) * 8, 8), :] = in_refs[k][:, j]
+        # stage this step's input window straight into the level-0
+        # buffer; un-overlapped: the copies are ~us against a ~200 us
+        # compute step.  DMA shapes must be static, so the circular
+        # wrap is handled by per-step static segment lists: only the
+        # last few steps wrap, and each such step's (dst, src, size)
+        # split is a compile-time constant — no padded copy of the
+        # 4 GB input (a device-side pad doubled input HBM and OOMed
+        # the 1M benchmark).
+        def stage(step, segs):
+            @pl.when(i_s == step)
+            def _():
+                for dst_off, src_off, size in segs:
+                    c = pltpu.make_async_copy(
+                        data_hbm.at[pl.ds(g * head.rows_in, head.rows_in),
+                                    pl.ds(src_off, size)],
+                        buf_a.at[pl.ds(0, head.rows_in),
+                                 pl.ds(dst_off, size)],
+                        sem_in)
+                    c.start()
+                    c.wait()
+
+        def segments(start):
+            segs, p = [], 0
+            while p < r_alloc:
+                src = (start + p) % c8
+                size = min(r_alloc - p, c8 - src)
+                segs.append((p, src, size))
+                p += size
+            return segs
+
+        n_wrap = min(n_slices,
+                     -(-(r_alloc - cpb * 8) // (cpb * 8)))
+        for w in range(n_wrap):
+            step = n_slices - 1 - w
+            stage(step, segments(step * cpb * 8))
+
+        if n_slices > n_wrap:
+            # generic branch: steps whose window stays in-bounds (dead
+            # -- and structurally oversized -- when the window laps the
+            # whole axis, so emitted only when some step qualifies)
+            @pl.when(i_s < n_slices - n_wrap)
+            def _():
+                c = pltpu.make_async_copy(
+                    data_hbm.at[pl.ds(g * head.rows_in, head.rows_in),
+                                pl.ds(i_s * cpb * 8, r_alloc)],
+                    buf_a.at[pl.ds(0, head.rows_in), pl.ds(0, r_alloc)],
+                    sem_in)
+                c.start()
+                c.wait()
 
         def shifted_chunk(src, row, c, s):
             """``src[row, c*CHUNK + s : +CHUNK]`` as an (8, L) tile.
@@ -259,11 +337,10 @@ def _build_head_kernel(nchan, start_freq, bandwidth, max_delay, min_delay,
         for lev in range(n_levels):
             il_t, ih_t, s_t, sh_t = tabs[4 * lev:4 * lev + 4]
             leaf = head.tables[lev]["leaf"]
-            final = lev == n_levels - 1
             nco = n_chunks_out[lev]
 
             def row_body(rb, _, il_t=il_t, ih_t=ih_t, s_t=s_t, sh_t=sh_t,
-                         leaf=leaf, final=final, nco=nco, src=src, dst=dst):
+                         leaf=leaf, nco=nco, src=src, dst=dst):
                 # row unroll: one loop iteration's scalar overhead
                 # (control flow + dynamic address formation) amortised
                 # over _ROW_UNROLL rows of vector work
@@ -278,32 +355,38 @@ def _build_head_kernel(nchan, start_freq, bandwidth, max_delay, min_delay,
                             high = shifted_chunk(src, ih, c, sh_t[g, r])
                         else:
                             high = src[ih, pl.ds(c * 8, 8), :]
-                        tile = low + high
-                        if final:
-                            out_ref[r, c] = tile
-                        else:
-                            dst[r, pl.ds(c * 8, 8), :] = tile
+                        dst[r, pl.ds(c * 8, 8), :] = low + high
                 return 0
 
             jax.lax.fori_loop(0, head.rows_out[lev] // _ROW_UNROLL,
                               row_body, 0)
             src, dst = dst, src
 
+        # the final level landed in `src` (post-swap): one DMA out
+        copy_out = pltpu.make_async_copy(
+            src.at[pl.ds(0, rows_final), pl.ds(0, cpb * 8)],
+            out_hbm.at[pl.ds(g * rows_final, rows_final),
+                       pl.ds(i_s * cpb * 8, cpb * 8)],
+            sem_out)
+        copy_out.start()
+        copy_out.wait()
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4 * n_levels,
         grid=grid,
-        in_specs=in_specs,
-        out_specs=out_spec,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
             pltpu.VMEM((rows_buf, r_alloc, _L), jnp.float32),
             pltpu.VMEM((rows_buf, r_alloc, _L), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
         ],
     )
     call = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (head.n_groups * head.rows_out[-1], n_slices * cpb, 8, _L),
-            jnp.float32),
+            (head.n_groups * rows_final, c8, _L), jnp.float32),
         interpret=bool(interpret))
 
     flat_tabs = []
@@ -319,10 +402,10 @@ def _build_head_kernel(nchan, start_freq, bandwidth, max_delay, min_delay,
 
     def run(data):
         # traceable (un-jitted) so the whole-transform jit can inline it
-        data4 = data.reshape(data.shape[0], n_slices * cpb, 8, _L)
-        out = call(*flat_tabs, *([data4] * k_in))
-        # (G*rows_max, n_chunks, 8, L) -> (rows_total, t)
-        out = out.reshape(head.n_groups, head.rows_out[-1], t)
+        data3 = data.reshape(data.shape[0], c8, _L)
+        out = call(*flat_tabs, data3)
+        # (G*rows_max, c8, L) -> (rows_total, t)
+        out = out.reshape(head.n_groups, rows_final, t)
         return out[jnp.asarray(gather_g), jnp.asarray(gather_r)]
 
     return run, head
@@ -344,7 +427,10 @@ def head_transform(data, max_delay, start_freq, bandwidth, min_delay=0,
     data = jnp.asarray(data, jnp.float32)
     nchan, t = data.shape
     if t_slice is None:
-        t_slice = HEAD_T_SLICE
+        t_slice = pick_head_t_slice(
+            _head_plan_cached(nchan, float(start_freq), float(bandwidth),
+                              int(max_delay), int(min_delay),
+                              int(n_levels)), int(t))
     run, head = _build_head_kernel(
         nchan, float(start_freq), float(bandwidth), int(max_delay),
         int(min_delay), int(n_levels), int(t), int(t_slice),
