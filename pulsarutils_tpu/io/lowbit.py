@@ -131,6 +131,38 @@ def native_available():
     return _load() is not None
 
 
+def device_unpack_block(frames, nbits, nchan, band_descending=False,
+                        xp=None):
+    """Jittable device unpack: packed frames -> ``(nchan, n)`` float32.
+
+    ``frames`` is the raw ``(nsamps, nbytes_per_frame)`` uint8 block a
+    low-bit filterbank stores (``FilterbankReader.read_block_packed``),
+    single-IF.  Same LSB-first convention as :func:`unpack_numpy`; the
+    returned block is ASCENDING-band (``band_descending=True`` flips
+    the file's channel order, mirroring ``read_block(band_ascending=
+    True)``).
+
+    Why this exists (round 4): the streaming pipeline used to unpack on
+    the host and upload float32 — 16x the bytes of a 2-bit file over
+    the host->device link, which is the survey bottleneck on thin
+    links (measured 647 s per 4 GB chunk on a congested tunnel).
+    Uploading the packed bytes and unpacking in the device-clean jit
+    moves the inflation to HBM, where it is free by comparison.
+    """
+    if xp is None:
+        import jax.numpy as xp
+    per = _PER_BYTE[nbits]
+    mask = (1 << nbits) - 1
+    frames = xp.asarray(frames)
+    shifts = xp.arange(per, dtype=xp.uint8) * np.uint8(nbits)
+    vals = (frames[:, :, None] >> shifts[None, None, :]) & np.uint8(mask)
+    block = vals.reshape(frames.shape[0], -1)[:, :nchan]
+    block = block.astype(xp.float32).T
+    if band_descending:
+        block = block[::-1]
+    return block
+
+
 def unpack_numpy(packed, nbits):
     """Numpy reference: packed uint8 -> float32, LSB-first."""
     packed = np.ascontiguousarray(packed, dtype=np.uint8).ravel()

@@ -196,6 +196,27 @@ class FilterbankReader:
         istart = int(istart)
         nsamps = int(min(nsamps, self.nsamples - istart))
         raw = np.asarray(self._mmap[istart:istart + nsamps])
+        return self.unpack_frames(raw, band_ascending=band_ascending)
+
+    def read_block_packed(self, istart, nsamps):
+        """Raw packed frames ``(nsamps, bytes_per_frame)`` uint8 — the
+        low-bit fast path: callers ship THESE over the host->device
+        link (1/16th the bytes of float32 at 2 bits) and unpack in the
+        device-clean jit (:func:`..io.lowbit.device_unpack_block`);
+        :meth:`unpack_frames` is the matching host-side decode for
+        fallback paths.  Low-bit files only."""
+        if self._nbits not in (1, 2, 4):
+            raise ValueError(
+                f"read_block_packed needs a packed low-bit file "
+                f"(nbits={self._nbits})")
+        istart = int(istart)
+        nsamps = int(min(nsamps, self.nsamples - istart))
+        return np.asarray(self._mmap[istart:istart + nsamps])
+
+    def unpack_frames(self, raw, band_ascending=False):
+        """Decode raw frames (packed low-bit or plain) to the
+        ``(nchan, nsamps)`` float block ``read_block`` returns."""
+        nsamps = raw.shape[0]
         if self._nbits in (1, 2, 4):
             from .lowbit import unpack
 
