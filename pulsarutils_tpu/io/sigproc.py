@@ -204,11 +204,18 @@ class FilterbankReader:
         link (1/16th the bytes of float32 at 2 bits) and unpack in the
         device-clean jit (:func:`..io.lowbit.device_unpack_block`);
         :meth:`unpack_frames` is the matching host-side decode for
-        fallback paths.  Low-bit files only."""
+        fallback paths.  Low-bit single-IF files only: the device-side
+        unpack takes the first ``nchans`` values of each frame, which on
+        a multi-IF file would silently decode IF 0 instead of honouring
+        ``if_mode`` the way :meth:`read_block` does."""
         if self._nbits not in (1, 2, 4):
             raise ValueError(
                 f"read_block_packed needs a packed low-bit file "
                 f"(nbits={self._nbits})")
+        if self.nifs != 1:
+            raise ValueError(
+                f"read_block_packed is single-IF only (nifs={self.nifs}); "
+                "use read_block, which honours if_mode")
         istart = int(istart)
         nsamps = int(min(nsamps, self.nsamples - istart))
         return np.asarray(self._mmap[istart:istart + nsamps])

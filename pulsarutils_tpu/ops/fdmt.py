@@ -528,19 +528,10 @@ def _head_enabled(use_pallas):
     loop 0.53 s (both scalar/instruction-bound, see
     ops/fdmt_resident.py) — so the knob stays for bisection.
     """
-    knob = os.environ.get("PUTPU_FDMT_HEAD", "")
-    if knob == "0":
-        return False
-    if knob == "1":
-        return True
-    if knob:
-        import warnings
+    from ..utils.knobs import tristate_env
 
-        # a silently-ignored 'off'/'true' would make an A/B bisection
-        # measure the same program twice (mirrors _merge_row_block)
-        warnings.warn(f"PUTPU_FDMT_HEAD={knob!r} ignored (expected '0' "
-                      "or '1'); using the platform default", stacklevel=2)
-    return bool(use_pallas)
+    knob = tristate_env("PUTPU_FDMT_HEAD")
+    return bool(use_pallas) if knob is None else knob
 
 
 def head_active(nchan, start_freq, bandwidth, max_delay, n_lo, t):
@@ -567,11 +558,27 @@ def head_active(nchan, start_freq, bandwidth, max_delay, n_lo, t):
                           max_level_shift=max(hp.max_shift_per_level))
 
 
+def _score_kernel_choice(use_pallas, interpret):
+    """Resolve the one-pass-scorer choice at a call site.
+
+    Like ``_head_enabled``: the result must be passed into
+    ``_transform_fn``/``_build_transform`` so it keys their lru/compile
+    caches — an in-builder env read would serve a stale compiled
+    program after toggling ``PUTPU_PALLAS_SCORE`` in-process.  Auto
+    (knob unset) enables the kernel on the compiled TPU path only
+    (interpret-mode Pallas is minutes-slow; tests opt in explicitly).
+    """
+    from .score_pallas import score_enabled
+
+    knob = score_enabled()
+    return (bool(use_pallas) and not interpret) if knob is None else knob
+
+
 @functools.lru_cache(maxsize=16)
 def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                   use_pallas, interpret, n_lo=0, with_scores=False,
                   with_plane=True, t_orig=None, with_cert=False,
-                  use_head=False):
+                  use_head=False, use_score=False):
     """The traceable (un-jitted) transform body: DM-pruned merges
     [+ scoring].  :func:`_build_transform` wraps it in ``jax.jit``;
     the hybrid search composes it with its fused seed-rescore program
@@ -634,13 +641,38 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
             plane = plane[:, :t_orig]
         if not with_scores:
             return plane
+        from .score_pallas import pick_score_tile
         from .search import score_profiles_chunked
 
-        # row-chunked scoring bounds the scorer's HBM temps (see
-        # score_profiles_chunked) while still emitting ONE (5, ndm)
-        # array ((6, ndm) with the hybrid's certificate row) -> one host
-        # readback round trip over the tunnel
-        stacked = score_profiles_chunked(plane, jnp, with_cert=with_cert)
+        # one-pass Pallas scorer (round 5): reads the plane once and
+        # accumulates per-row partials in VMEM — the XLA chunked scorer
+        # materialises ~9 GB of mean-sub/pyramid/sliding temps at the
+        # 513 x 1M coarse plane and measured 0.17 s standalone against
+        # this kernel's ~0.02 s.  ``use_score`` is resolved by the
+        # caller via _score_kernel_choice (auto on compiled TPU;
+        # PUTPU_PALLAS_SCORE=0|1 bisects) so it keys the compile caches.
+        if use_score and not pick_score_tile(plane.shape[1]):
+            import warnings
+
+            # trace-time, once per shape: a silent fall-through would
+            # make a PUTPU_PALLAS_SCORE A/B bisection measure the same
+            # XLA scorer twice (the _head_enabled lesson)
+            warnings.warn(
+                f"one-pass scorer unavailable: no supported tile "
+                f"divides T={plane.shape[1]}; falling back to the XLA "
+                "chunked scorer", stacklevel=2)
+        if use_score and pick_score_tile(plane.shape[1]):
+            from .score_pallas import score_plane_pallas
+
+            stacked = score_plane_pallas(plane, with_cert=with_cert,
+                                         interpret=interpret)
+        else:
+            # row-chunked scoring bounds the scorer's HBM temps (see
+            # score_profiles_chunked) while still emitting ONE (5, ndm)
+            # array ((6, ndm) with the hybrid's certificate row) -> one
+            # host readback round trip over the tunnel
+            stacked = score_profiles_chunked(plane, jnp,
+                                             with_cert=with_cert)
         return (stacked, plane) if with_plane else stacked
 
     return fn
@@ -650,7 +682,7 @@ def _transform_fn(nchan, start_freq, bandwidth, max_delay, t, t_tile,
 def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                      use_pallas, interpret, n_lo=0, with_scores=False,
                      with_plane=True, t_orig=None, with_cert=False,
-                     use_head=False):
+                     use_head=False, use_score=False):
     """Jitted wrapper of :func:`_transform_fn` (same signature)."""
     import jax
 
@@ -658,7 +690,8 @@ def _build_transform(nchan, start_freq, bandwidth, max_delay, t, t_tile,
                                  t, t_tile, use_pallas, interpret,
                                  n_lo=n_lo, with_scores=with_scores,
                                  with_plane=with_plane, t_orig=t_orig,
-                                 with_cert=with_cert, use_head=use_head))
+                                 with_cert=with_cert, use_head=use_head,
+                                 use_score=use_score))
 
 
 # ---------------------------------------------------------------------------

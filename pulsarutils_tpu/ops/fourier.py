@@ -473,10 +473,12 @@ def _fourier_device_run(data, trial_dms, start_freq, bandwidth, sample_time,
     if dm_step is not None:
         # the VMEM-resident rotation kernel: default on TPU;
         # PUTPU_FDD_PALLAS=0|1 overrides (1 off-TPU = interpret mode,
-        # the CPU test path)
-        knob = os.environ.get("PUTPU_FDD_PALLAS", "")
+        # the CPU test path); garbage values warn via the shared parser
+        from ..utils.knobs import tristate_env
+
+        knob = tristate_env("PUTPU_FDD_PALLAS")
         on_tpu = jax.default_backend() == "tpu"
-        use_pallas = knob == "1" or (knob != "0" and on_tpu)
+        use_pallas = on_tpu if knob is None else knob
         superblock = dm_block or FOURIER_SUPERBLOCK
         # clamp to the trial count BEFORE the budget check: a 512-block
         # request over 8 trials would otherwise warn and shrink

@@ -328,7 +328,7 @@ def _jax_search_kernel(capture_plane, chan_block):
 PALLAS_SUPERBLOCK = 512
 
 
-def plane_memmap(ndm, nsamples, directory=None):
+def plane_memmap(ndm, nsamples, directory=None, delete=False):
     """A disk-backed ``(ndm, nsamples)`` float32 plane (``.npy`` memmap).
 
     The reference spills its dedispersed plane to a disk memmap so
@@ -338,18 +338,45 @@ def plane_memmap(ndm, nsamples, directory=None):
     driver nodes.  The file is a valid ``.npy`` (``np.load(...,
     mmap_mode=...)`` reopens it); its path is ``plane.filename``.
     Directory: ``directory`` arg, else ``$PUTPU_PLANE_DIR``, else the
-    system temp dir.  Deletion is the caller's: the file persists so
-    diagnostics can outlive the search (delete via
-    ``os.unlink(plane.filename)`` when done).
+    system temp dir (size that directory for ndm*nsamples*4 bytes per
+    concurrent capture).  Deletion: by default the file persists so
+    diagnostics can outlive the search — free it with
+    :func:`release_plane` (or ``os.unlink(plane.filename)``) when done;
+    ``delete=True`` instead ties the file's lifetime to the returned
+    memmap (``weakref.finalize`` unlinks it at garbage collection), so
+    repeated captures cannot silently fill the temp dir.
     """
     import tempfile
+    import weakref
 
     directory = directory or os.environ.get("PUTPU_PLANE_DIR") or None
     fd, path = tempfile.mkstemp(suffix=".npy", prefix="putpu_plane_",
                                 dir=directory)
     os.close(fd)
-    return np.lib.format.open_memmap(path, mode="w+", dtype=np.float32,
-                                     shape=(int(ndm), int(nsamples)))
+    mm = np.lib.format.open_memmap(path, mode="w+", dtype=np.float32,
+                                   shape=(int(ndm), int(nsamples)))
+    if delete:
+        weakref.finalize(mm, _unlink_quiet, path)
+    return mm
+
+
+def _unlink_quiet(path):
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def release_plane(plane):
+    """Unlink the disk file behind a :func:`plane_memmap` capture.
+
+    Accepts any plane a search returned: a plain ndarray (no-op) or a
+    ``np.memmap``-backed capture, whose ``.npy`` file is removed.  Safe
+    to call twice.
+    """
+    path = getattr(plane, "filename", None)
+    if path:
+        _unlink_quiet(path)
 
 
 @functools.lru_cache(maxsize=8)
@@ -418,7 +445,8 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     """
     import jax.numpy as jnp
 
-    from .fdmt import (_build_transform, _head_enabled, _transform_setup,
+    from .fdmt import (_build_transform, _head_enabled,
+                       _score_kernel_choice, _transform_setup,
                        fdmt_trial_dms)
 
     nchan = data.shape[0]
@@ -435,7 +463,9 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                            n_lo=n_lo, with_scores=True,
                            with_plane=capture_plane, t_orig=t_orig,
                            with_cert=with_cert,
-                           use_head=_head_enabled(use_pallas))
+                           use_head=_head_enabled(use_pallas),
+                           use_score=_score_kernel_choice(use_pallas,
+                                                          interpret))
     out = run(data)
     if capture_plane:
         stacked, plane_out = out  # plane stays device-resident
@@ -788,7 +818,8 @@ HYBRID_NEED_BUCKET = 8
 @functools.lru_cache(maxsize=8)
 def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, n_lo, t_orig, max_off, ndm_plan,
-                              bucket, use_head=False, bucket2=0):
+                              bucket, use_head=False, bucket2=0,
+                              use_score=False):
     """ONE jitted program for the hybrid's first round on TPU:
 
     FDMT coarse sweep -> plan-grid score mapping -> device-side top-k
@@ -828,7 +859,7 @@ def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, True, False, n_lo=n_lo,
                               with_scores=True, with_plane=False,
                               t_orig=t_orig, with_cert=True,
-                              use_head=use_head)
+                              use_head=use_head, use_score=use_score)
     k = min(HYBRID_SEED_TOPK, ndm_plan)  # top_k requires k <= axis size
 
     @jax.jit
@@ -1079,10 +1110,13 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         # the head flag is resolved HERE so it keys the builder's lru
         # cache (an in-builder env read would serve a stale compiled
         # program after toggling PUTPU_FDMT_HEAD in-process)
+        from .fdmt import _score_kernel_choice
+
         kernel = _fused_hybrid_seed_kernel(
             nchan, float(start_freq), float(bandwidth), n_hi, nsamples,
             t_tile, n_lo, None, max_off, ndm, bucket,
-            use_head=_head_enabled(True), bucket2=bucket2)
+            use_head=_head_enabled(True), bucket2=bucket2,
+            use_score=_score_kernel_choice(True, False))
         offs_dev = _device_offsets_cache(rebased_full.tobytes(),
                                          rebased_full.shape)
         packed = np.asarray(kernel(

@@ -88,8 +88,14 @@ def _h_program(mesh, axis, window, nmax):
     def local(rows, valid):
         r = quick_resample(rows, window, xp=jnp) if window > 1 else rows
         masked = jnp.where(valid[:, None], r, jnp.nan)
-        med = jnp.nanmedian(masked)
-        scale = jnp.nanmedian(jnp.abs(masked - med)) / MAD_SCALE
+        # a shard whose rows are ALL pad (small planes on big meshes)
+        # would make both nanmedians NaN and poison its digitize/H
+        # outputs; those values are never gathered (row_index skips pad
+        # rows) but benign zeros beat silent NaN propagation (ADVICE r4)
+        any_valid = jnp.any(valid)
+        med = jnp.where(any_valid, jnp.nanmedian(masked), 0.0)
+        scale = jnp.where(
+            any_valid, jnp.nanmedian(jnp.abs(masked - med)) / MAD_SCALE, 1.0)
         counts = jnp.maximum(
             digitize(r, xp=jnp, center=med, scale=scale), 0)
         h, m = h_test_batch(counts, nmax=nmax, xp=jnp)

@@ -494,6 +494,18 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 # miss risk, table.meta["cert_miss_p_at_floor"] — so
                 # is_hit is False by construction) and no exact
                 # rescoring was paid
+                if ncertified == 0:
+                    # state the operating assumption once, where
+                    # certification is consumed: the certificate is
+                    # probabilistic, and the at-floor miss risk is a
+                    # tunable (cert_slack / cert_slack_for_miss_p), not
+                    # fine print (ADVICE r4)
+                    logger.info(
+                        "noise certificate active: certified chunks skip "
+                        "exact rescoring; worst-case at-floor miss "
+                        "probability %.3g (tune via cert_slack, see "
+                        "ops.certify.cert_slack_for_miss_p)",
+                        table.meta.get("cert_miss_p_at_floor", float("nan")))
                 ncertified += 1
 
             if period_search and plane is not None:

@@ -1,0 +1,35 @@
+"""Shared tri-state environment-knob parser.
+
+Every Pallas-path bisection knob (``PUTPU_FDMT_HEAD``,
+``PUTPU_PALLAS_SCORE``, ``PUTPU_FDD_PALLAS``) follows the same
+contract: ``''``/unset means *auto* (platform default), ``'0'`` forces
+off, ``'1'`` forces on, and anything else WARNS and falls back to auto
+— a silently-ignored ``'true'``/``'off'`` would make an A/B bisection
+measure the same compiled program twice (the ``_head_enabled`` lesson,
+round 3).  Three hand-rolled copies of this parser had already drifted
+(``PUTPU_FDD_PALLAS`` silently ignored garbage — code-review r5); this
+helper pins the behaviour once.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def tristate_env(name):
+    """Parse env knob ``name``: True / False / None (auto).
+
+    Warns (and returns None) on any value other than '', '0', '1'.
+    """
+    knob = os.environ.get(name, "")
+    if knob == "0":
+        return False
+    if knob == "1":
+        return True
+    if knob:
+        import warnings
+
+        warnings.warn(
+            f"{name}={knob!r} ignored (expected '0' or '1'); using the "
+            "platform default", stacklevel=3)
+    return None
