@@ -92,23 +92,41 @@ def upload(array):
     import numpy as np
 
     # upload once, outside any timed region: the tunnel to the TPU has
-    # highly variable bandwidth (15 s .. 380 s for 4 GB measured) and the
-    # streaming pipeline double-buffers uploads anyway
+    # highly variable bandwidth (15 s .. 930 s for 4 GB measured) and the
+    # streaming pipeline double-buffers uploads anyway.  The measured
+    # upload seconds are reported in the JSON so a congested session is
+    # visible next to the headline instead of silently poisoning it
+    # (VERDICT r4 #2a).
     t0 = time.time()
     device_array = jnp.asarray(array, dtype=jnp.float32)
     _ = np.asarray(device_array[0, :8])  # force (block_until_ready lies
     # on the tunnelled platform)
-    log(f"host->device upload: {time.time() - t0:.1f}s")
-    return device_array
+    dt = time.time() - t0
+    log(f"host->device upload: {dt:.1f}s")
+    return device_array, dt
 
 
-def measure_kernel(device_array, kernel, repeats=2):
+#: headline timing protocol (VERDICT r4 #2a): at least MIN_REPEATS
+#: steady-state sweeps, extended up to MAX_REPEATS until the spread of
+#: the best three falls under SPREAD_BOUND — a congested session then
+#: flags the artifact instead of silently shipping whatever the tunnel
+#: allowed that minute (round 4's committed headline lost 11% to a
+#: single congested run)
+MIN_REPEATS = 5
+MAX_REPEATS = 9
+SPREAD_BOUND = 0.06
+
+
+def measure_kernel(device_array, kernel, repeats=2, stabilize=False):
     """Warm + time steady-state sweeps (best of ``repeats``).
 
     Steady-state times vary ±15% run-to-run on the tunnelled platform
-    (shared worker, host jitter); min-of-2 is the honest steady-state
-    estimator — both raw times are logged.
-    Returns ``(table, trials/s, secs)``.
+    (shared worker, host jitter); min-of-N is the honest steady-state
+    estimator — all raw times are logged.  With ``stabilize`` (the
+    headline protocol) repeats extend up to :data:`MAX_REPEATS` until
+    the relative spread of the best three times is under
+    :data:`SPREAD_BOUND`.
+    Returns ``(table, trials/s, secs, timing_dict)``.
     """
     from pulsarutils_tpu.ops.search import dedispersion_search
     from pulsarutils_tpu.utils.logging_utils import device_trace
@@ -122,6 +140,9 @@ def measure_kernel(device_array, kernel, repeats=2):
     table = run()
     log(f"first run (incl. compile): {time.time() - t0:.2f}s")
 
+    if stabilize:
+        repeats = max(repeats, MIN_REPEATS)
+
     trace_dir = os.environ.get("BENCH_TRACE")
     times = []
     with device_trace(trace_dir):  # no-op when BENCH_TRACE unset
@@ -130,15 +151,30 @@ def measure_kernel(device_array, kernel, repeats=2):
         times.append(time.time() - t0)
     if trace_dir:
         log(f"profiler trace written to {trace_dir}")
-    for _ in range(repeats - 1):  # outside the trace: one sweep per capture
+
+    def spread_best3():
+        if len(times) < 3:
+            return float("inf")
+        best3 = sorted(times)[:3]
+        return (best3[2] - best3[0]) / best3[0]
+
+    while len(times) < repeats or (
+            stabilize and spread_best3() > SPREAD_BOUND
+            and len(times) < MAX_REPEATS):
         t0 = time.time()
         table = run()
         times.append(time.time() - t0)
     dt = min(times)
+    timing = {"times_s": [round(x, 3) for x in times],
+              "spread_best3": round(spread_best3(), 4)}
+    if stabilize:
+        timing["stable"] = spread_best3() <= SPREAD_BOUND
+        timing["spread_bound"] = SPREAD_BOUND
     log(f"kernel={kernel}: {dt:.3f}s steady-state "
-        f"(best of {[round(x, 3) for x in times]}), {table.nrows} trials "
+        f"(best of {timing['times_s']}, best-3 spread "
+        f"{timing['spread_best3']:.1%}), {table.nrows} trials "
         f"-> {table.nrows / dt:.1f} DM-trials/s")
-    return table, table.nrows / dt, dt
+    return table, table.nrows / dt, dt, timing
 
 
 def measure_numpy_baseline(array, nsamp):
@@ -234,16 +270,18 @@ def main():
         attempts.append((nchan, nsamp // 4))
     table = array = device_array = None
     measured_kernel = kernel
+    upload_s = None
+    headline_timing = None
     for i, (nc, ns) in enumerate(attempts):
         # rebuild at each size so the injected pulse and the full DM span
         # survive the reduction (slicing would lose both)
         sub = make_data(nc, ns) if i > 0 or array is None else array
         try:
-            device_array = upload(sub)
+            device_array, upload_s = upload(sub)
             for j, kern in enumerate(chain):
                 try:
-                    table, jax_tps, jax_time = measure_kernel(
-                        device_array, kern)
+                    table, jax_tps, jax_time, headline_timing = \
+                        measure_kernel(device_array, kern, stabilize=True)
                     measured_kernel = kern
                     if j > 0:
                         degraded = (f"kernel={chain[0]} failed; "
@@ -291,7 +329,7 @@ def main():
     exact_hit_match = None
     if measured_kernel == "hybrid" and platform == "tpu":
         try:
-            t2, tps2, dt2 = measure_kernel(device_array, "pallas")
+            t2, tps2, dt2, _ = measure_kernel(device_array, "pallas")
             best_h, best_p = table.argbest("snr"), t2.argbest("snr")
             exact_hit_match = {
                 "argbest_equal": best_h == best_p,
@@ -339,7 +377,7 @@ def main():
                 degraded, "exact_hit_match verification DID NOT RUN "
                           "(exact pallas sweep failed)"]))
         try:
-            t3, tps3, dt3 = measure_kernel(device_array, "fdmt")
+            t3, tps3, dt3, _ = measure_kernel(device_array, "fdmt")
             secondary.append({
                 "kernel": "fdmt (coarse sweep alone)",
                 "trials_per_sec": round(tps3, 1),
@@ -350,7 +388,7 @@ def main():
             log(f"secondary fdmt metric skipped: {exc!r}")
     elif measured_kernel == "fdmt" and platform == "tpu":
         try:
-            t2, tps2, dt2 = measure_kernel(device_array, "pallas")
+            t2, tps2, dt2, _ = measure_kernel(device_array, "pallas")
             secondary.append({
                 "kernel": "pallas (bit-exact hit detection)",
                 "trials_per_sec": round(tps2, 1),
@@ -381,6 +419,20 @@ def main():
         "best_dm": float(table["DM"][table.argbest()]),
         "injected_dm": INJECT_DM,
     }
+    if headline_timing is not None:
+        result["timing"] = headline_timing
+        if not headline_timing.get("stable", True):
+            # the stated variance bound was not reached within
+            # MAX_REPEATS: the headline is whatever the tunnel allowed —
+            # flag it rather than stamping it as a clean measurement
+            degraded = "; ".join(filter(None, [
+                degraded,
+                f"timing unstable: best-3 spread "
+                f"{headline_timing['spread_best3']:.1%} exceeds the "
+                f"{SPREAD_BOUND:.0%} bound after "
+                f"{len(headline_timing['times_s'])} repeats"]))
+    if upload_s is not None:
+        result["upload_s"] = round(upload_s, 1)
     if exact_hit_match is not None:
         result["exact_hit_match"] = exact_hit_match
     if secondary:

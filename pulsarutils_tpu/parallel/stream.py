@@ -76,12 +76,23 @@ def plan_chunks(nsamples, sample_time, dmmin, dmmax, start_freq, stop_freq,
 
 def iter_chunk_starts(nsamples, plan, tmin=0, sample_time=None):
     """Chunk start indices with 50% overlap, skipping a final fragment
-    shorter than half a chunk (reference ``clean.py:318-325``)."""
+    shorter than half a chunk (reference ``clean.py:318-325``) — and,
+    round 5, a final fragment *wholly contained* in the previous chunk
+    (``istart - hop + step >= nsamples``): it re-reads data the previous
+    full-length chunk already searched with MORE context (the short time
+    axis only worsens circular-wrap artifacts) while costing a complete
+    extra compile set for the odd shape (~minutes on the 1M-sample
+    configs — measured in the round-5 survey rehearsal)."""
+    prev = None
     for istart in range(0, nsamples, plan.hop):
         if sample_time is not None and istart * sample_time < tmin:
             continue
         if min(plan.step, nsamples - istart) < plan.hop:
             continue
+        if (prev is not None and istart - plan.hop == prev
+                and prev + plan.step >= nsamples):
+            continue
+        prev = istart
         yield istart
 
 

@@ -584,4 +584,32 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     timer.report()
     logger.info("done: %d chunks processed, %d hits, %d noise-certified",
                 nproc, len(hits), ncertified)
+    if resume:
+        # a resumed run must report the COMPLETE result, not just this
+        # session's chunks: candidates persisted by interrupted runs are
+        # restored from the store so downstream sifting/reporting sees
+        # every detection (round-5 survey rehearsal: the injected pulse
+        # was found before the interrupt and then absent from the
+        # resumed run's report)
+        seen = {(h[0], h[1]) for h in hits}
+        restored = 0
+        for cand_root, lo, hi in store.candidates():
+            # only chunks this fingerprint's ledger marks done: the
+            # store directory may hold same-named candidates persisted
+            # by other configurations
+            if (cand_root != root or (lo, hi) in seen
+                    or not store.is_done(lo)):
+                continue
+            try:
+                info, table = store.load_candidate(root, lo, hi)
+            except Exception as exc:  # a partial/corrupt pair: skip it
+                logger.warning("could not restore candidate %s_%d-%d: %r",
+                               root, lo, hi, exc)
+                continue
+            hits.append((lo, hi, info, table))
+            restored += 1
+        if restored:
+            hits.sort(key=lambda h: h[0])
+            logger.info("restored %d persisted candidate(s) from the "
+                        "resume ledger", restored)
     return hits, store

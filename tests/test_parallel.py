@@ -158,6 +158,14 @@ def test_iter_chunk_starts_overlap_and_tail():
     # tmin skips early chunks
     starts_t = list(iter_chunk_starts(320, plan, tmin=120, sample_time=1.0))
     assert starts_t == [150, 200, 250]
+    # a final half-chunk fragment wholly contained in the previous
+    # full-length chunk is skipped (it re-searches covered data at a
+    # fresh compile shape — round 5)
+    assert list(iter_chunk_starts(300, plan)) == [0, 50, 100, 150, 200]
+    # ... but kept when it is the ONLY chunk covering its span
+    assert list(iter_chunk_starts(50, plan)) == [0]
+    assert list(iter_chunk_starts(300, plan, tmin=250,
+                                  sample_time=1.0)) == [250]
 
 
 def test_stream_search_finds_pulse_in_right_chunk():

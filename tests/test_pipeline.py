@@ -65,13 +65,24 @@ def test_search_by_chunks_resume(pulse_file, tmp_path):
     hits1, store1 = search_by_chunks(path, max_chunks=2, **kwargs)
     done_first = store1.done_chunks
     assert len(done_first) == 2
-    # second run continues where the first stopped
+    # the resumed run continues where the first stopped AND restores the
+    # interrupted run's persisted candidates, so its hits list is the
+    # COMPLETE result (round-5 rehearsal: a pulse found before the
+    # interrupt must not vanish from the resumed run's report)
     hits2, store2 = search_by_chunks(path, **kwargs)
     assert set(store2.done_chunks) >= set(done_first)
-    # a fully processed file re-run does nothing new
+    spans1 = {(h[0], h[1]) for h in hits1}
+    spans2 = {(h[0], h[1]) for h in hits2}
+    assert spans1 <= spans2
+    # a fully processed file re-run reprocesses nothing but still
+    # reports every persisted candidate
     hits3, store3 = search_by_chunks(path, **kwargs)
     assert store3.done_chunks == store2.done_chunks
-    assert hits3 == []
+    assert {(h[0], h[1]) for h in hits3} == spans2
+    # restored tuples carry usable info/table payloads
+    for _, _, info, table in hits3:
+        assert np.isfinite(info.snr)
+        assert table.nrows > 0
 
 
 def test_resume_ledger_invalidated_by_config_change(tmp_path):

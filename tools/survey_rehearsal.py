@@ -12,14 +12,18 @@ Stages:
      broadband periodic interference);
   2. run the actual CLI (``python -m pulsarutils_tpu.cli.search_main``)
      twice: first capped at half the chunks (simulated interruption),
-     then to completion — the second run must RESUME from the ledger;
+     then to completion — the second run must RESUME from the ledger
+     (and must report the interrupted run's persisted candidates, the
+     round-5 restore fix);
   3. verify every injected pulse is recovered (time + DM) from the
-     persisted candidates;
-  4. write ``docs/survey_rehearsal_r4.md`` with per-stage wall-clock,
-     chunks/s and the recovery table.
+     resumed run's complete candidate report;
+  4. measure the low-bit link saving: packed-byte upload vs an
+     equal-byte float32 upload on the live tunnel (VERDICT r4 #1);
+  5. write ``docs/survey_rehearsal_r5.md`` with per-stage wall-clock,
+     chunks/s, the recovery table and the link A/B.
 
 Usage: python tools/survey_rehearsal.py [--gb 2.0] [--dir /tmp/survey]
-       [--out docs/survey_rehearsal_r4.md] [--keep]
+       [--out docs/survey_rehearsal_r5.md] [--keep]
 """
 
 import argparse
@@ -147,12 +151,55 @@ def parse_report(out):
                     else None), cands
 
 
+def measure_link_ab(path, log):
+    """Packed vs float32 upload A/B on the live tunnel (one chunk).
+
+    Ships chunk 0's PACKED bytes and an equal-byte float32 slab,
+    forcing each with a readback; rates extrapolate to the per-chunk
+    upload cost either way (the packed chunk decodes to 16x the bytes
+    at 2 bits, so equal-rate transfers mean a 16x per-chunk saving).
+    """
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.io.sigproc import FilterbankReader
+
+    reader = FilterbankReader(path)
+    step = 1 << 20
+    raw = reader.read_block_packed(0, step)
+    packed_mb = raw.nbytes / 2**20
+    f32_bytes = step * reader.nchans * 4
+
+    def ship(arr):
+        t0 = time.time()
+        dev = jnp.asarray(arr)
+        np.asarray(dev.reshape(-1)[:8])  # force
+        return time.time() - t0
+
+    ship(np.zeros((8, 8), np.float32))  # warm the tunnel/session
+    t_packed = ship(raw)
+    slab = np.zeros(raw.nbytes // 4, np.float32)
+    t_f32_slab = ship(slab)
+    rate_packed = packed_mb / t_packed
+    rate_f32 = packed_mb / t_f32_slab
+    t_f32_chunk_est = (f32_bytes / 2**20) / rate_f32
+    log(f"link A/B: packed {packed_mb:.0f} MiB in {t_packed:.1f}s "
+        f"({rate_packed:.0f} MiB/s); float32 same bytes in "
+        f"{t_f32_slab:.1f}s ({rate_f32:.0f} MiB/s) -> full float32 "
+        f"chunk est {t_f32_chunk_est:.0f}s vs packed {t_packed:.1f}s "
+        f"({t_f32_chunk_est / max(t_packed, 1e-9):.1f}x)")
+    return {"packed_mb": packed_mb, "t_packed": t_packed,
+            "t_f32_slab": t_f32_slab,
+            "f32_chunk_mb": f32_bytes / 2**20,
+            "t_f32_chunk_est": t_f32_chunk_est}
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--gb", type=float, default=2.0)
     p.add_argument("--dir", default="/tmp/survey_rehearsal")
     p.add_argument("--out", default=None)
     p.add_argument("--keep", action="store_true")
+    p.add_argument("--skip-link-ab", action="store_true")
     opts = p.parse_args(argv)
 
     os.makedirs(opts.dir, exist_ok=True)
@@ -185,6 +232,11 @@ def main(argv=None):
     stages, done2, cands = parse_report(out2)
     log(f"  run2: {done2} wall={wall2:.0f}s stages={stages}")
 
+    link = None
+    if not opts.skip_link_ab:
+        log("link A/B: packed vs float32 upload ...")
+        link = measure_link_ab(path, log)
+
     # recovery check: every injected pulse matched by a candidate at
     # (time within the 50%-overlap tolerance, DM within 2 trials)
     rows = []
@@ -209,7 +261,7 @@ def main(argv=None):
     if opts.out:
         total = sum(v[0] for v in stages.values()) or 1.0
         lines = [
-            "# Survey rehearsal (round 4) — file -> hits on hardware",
+            "# Survey rehearsal (round 5) — file -> hits on hardware",
             "",
             f"- file: {size / 2**30:.2f} GiB 2-bit SIGPROC, {NCHAN} chan x "
             f"{nsamples} samples ({nsamples * TSAMP:.0f} s of data), "
@@ -245,6 +297,21 @@ def main(argv=None):
                    if best else "**MISSED**")
             lines.append(f"| {t_pulse:.2f} | {dm:.1f} | {width} | "
                          f"{amp:.2f} | {rec} |")
+        if link:
+            lines += [
+                "",
+                "## Low-bit link A/B (measured on the live tunnel)",
+                "",
+                f"- packed chunk upload: {link['packed_mb']:.0f} MiB in "
+                f"{link['t_packed']:.1f} s",
+                f"- float32 slab, same byte count: "
+                f"{link['t_f32_slab']:.1f} s",
+                f"- full float32 chunk ({link['f32_chunk_mb']:.0f} MiB) "
+                f"estimate: {link['t_f32_chunk_est']:.0f} s -> the "
+                f"packed path ships each chunk "
+                f"{link['t_f32_chunk_est'] / max(link['t_packed'], 1e-9):.1f}x "
+                "faster (16x fewer bytes at 2 bits)",
+            ]
         with open(opts.out, "w") as f:
             f.write("\n".join(lines) + "\n")
         log(f"report -> {opts.out}")
