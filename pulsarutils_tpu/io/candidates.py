@@ -81,12 +81,62 @@ class CandidateStore:
     def _base(self, root, istart, iend):
         return os.path.join(self.directory, f"{root}_{istart}-{iend}")
 
+    #: persisted-waterfall element budget: above this, ``save_candidate``
+    #: stores a window around the pulse instead of the whole chunk (a
+    #: 1024 x 1M survey chunk is a multi-GB compressed npz per hit and
+    #: took ~10 min of single-core zlib per candidate — measured in the
+    #: round-5 survey rehearsal, where persist dominated the pipeline)
+    WATERFALL_BUDGET = 1 << 22
+
     def save_candidate(self, root, istart, iend, info: PulseInfo,
                        table: ResultTable):
         base = self._base(root, istart, iend)
-        info.save(base + ".info.npz")
+        self._trim_waterfall(info, table).save(base + ".info.npz")
         table.to_npz(base + ".table.npz")
         return base
+
+    def _trim_waterfall(self, info, table):
+        """Bound the persisted record: full chunk in, pulse cutout out.
+
+        The window covers the dispersed track — ``[peak - pad,
+        peak + span + pad]`` with ``span`` the band-crossing delay at
+        the candidate's DM — then block-sum decimates if still over
+        budget.  The in-memory ``info`` (diagnostics plotting, the
+        returned hits list) is untouched; only the persisted copy is
+        trimmed, with ``cutout_start``/``cutout_decim`` recording the
+        window (see :class:`..pipeline.pulse_info.PulseInfo`).
+        """
+        import dataclasses
+
+        import numpy as np
+
+        wf = info.allprofs
+        if wf is None or wf.size <= self.WATERFALL_BUDGET:
+            return info
+        nbin = wf.shape[1]
+        tsamp = (1.0 / (info.pulse_freq * info.nbin)
+                 if info.pulse_freq and info.nbin else None)
+        best = table.best_row()
+        peak = int(best["peak"]) if "peak" in table.colnames else nbin // 2
+        span = 256
+        if tsamp and info.start_freq and info.bandwidth and best["DM"]:
+            from ..ops.plan import delta_delay
+
+            span = int(delta_delay(float(best["DM"]), info.start_freq,
+                                   info.start_freq + info.bandwidth)
+                       / tsamp) + 1
+        pad = max(span // 2, 256)
+        lo = max(0, peak - pad)
+        hi = min(nbin, peak + span + pad)
+        cut = np.asarray(wf[:, lo:hi])
+        decim = 1
+        if cut.size > self.WATERFALL_BUDGET:
+            from ..ops.rebin import quick_resample
+
+            decim = -(-cut.size // self.WATERFALL_BUDGET)
+            cut = np.asarray(quick_resample(cut, decim))
+        return dataclasses.replace(info, allprofs=cut, cutout_start=lo,
+                                   cutout_decim=decim)
 
     def load_candidate(self, root, istart, iend):
         base = self._base(root, istart, iend)

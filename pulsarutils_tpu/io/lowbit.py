@@ -49,9 +49,14 @@ def _build_library():
     newer than the cached binary.
     """
     tag = f"cpython{sys.version_info.major}{sys.version_info.minor}"
+    # per-user temp dir: os.getuid does not exist on Windows — fall
+    # back to USERNAME there (the windows CI leg must reach the numpy
+    # fallback through the normal probe chain, not an AttributeError)
+    uid = (os.getuid() if hasattr(os, "getuid")
+           else os.environ.get("USERNAME", "user"))
     build_dirs = [os.path.dirname(_SRC),
                   os.path.join(tempfile.gettempdir(),
-                               f"pulsarutils_tpu_native_{os.getuid()}")]
+                               f"pulsarutils_tpu_native_{uid}")]
     for d in build_dirs:
         try:
             os.makedirs(d, exist_ok=True)

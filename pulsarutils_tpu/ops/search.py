@@ -458,6 +458,8 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     # scoring (and the row slice) run inside the transform's jit: only
     # the per-trial score vectors (and optionally the plane) leave the
     # device, keeping back-to-back searches within HBM
+    from .fdmt import _deep_pair_enabled
+
     run = _build_transform(nchan, float(start_freq), float(bandwidth),
                            n_hi, t_run, t_tile, use_pallas, interpret,
                            n_lo=n_lo, with_scores=True,
@@ -465,7 +467,8 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                            with_cert=with_cert,
                            use_head=_head_enabled(use_pallas),
                            use_score=_score_kernel_choice(use_pallas,
-                                                          interpret))
+                                                          interpret),
+                           deep_pair=_deep_pair_enabled())
     out = run(data)
     if capture_plane:
         stacked, plane_out = out  # plane stays device-resident
@@ -819,7 +822,7 @@ HYBRID_NEED_BUCKET = 8
 def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, n_lo, t_orig, max_off, ndm_plan,
                               bucket, use_head=False, bucket2=0,
-                              use_score=False):
+                              use_score=False, deep_pair=False):
     """ONE jitted program for the hybrid's first round on TPU:
 
     FDMT coarse sweep -> plan-grid score mapping -> device-side top-k
@@ -859,7 +862,8 @@ def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, True, False, n_lo=n_lo,
                               with_scores=True, with_plane=False,
                               t_orig=t_orig, with_cert=True,
-                              use_head=use_head, use_score=use_score)
+                              use_head=use_head, use_score=use_score,
+                              deep_pair=deep_pair)
     k = min(HYBRID_SEED_TOPK, ndm_plan)  # top_k requires k <= axis size
 
     @jax.jit
@@ -1110,13 +1114,14 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         # the head flag is resolved HERE so it keys the builder's lru
         # cache (an in-builder env read would serve a stale compiled
         # program after toggling PUTPU_FDMT_HEAD in-process)
-        from .fdmt import _score_kernel_choice
+        from .fdmt import _deep_pair_enabled, _score_kernel_choice
 
         kernel = _fused_hybrid_seed_kernel(
             nchan, float(start_freq), float(bandwidth), n_hi, nsamples,
             t_tile, n_lo, None, max_off, ndm, bucket,
             use_head=_head_enabled(True), bucket2=bucket2,
-            use_score=_score_kernel_choice(True, False))
+            use_score=_score_kernel_choice(True, False),
+            deep_pair=_deep_pair_enabled())
         offs_dev = _device_offsets_cache(rebased_full.tobytes(),
                                          rebased_full.shape)
         packed = np.asarray(kernel(
@@ -1222,7 +1227,9 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         sample_time=sample_time, nsamples=nsamples, snr_floor=snr_floor,
         noise_certificate=noise_certificate, seed_done=fused_seed,
         rho_cert=rho_cert, cert_slack=cert_slack)
-    logger.debug("hybrid: %d/%d rows rescored exactly%s", exact.sum(), ndm,
+    logger.debug("hybrid: %d/%d rows rescored exactly%s%s", exact.sum(), ndm,
+                 f" (device need stage flagged {n_need})" if fused_seed
+                 else "",
                  " (noise-certified)" if certified else "")
 
     return (maxvalues, stds, snrs, windows, peaks, exact, plane,

@@ -49,6 +49,15 @@ class PulseInfo:
     allprofs: np.ndarray | None = None        # (nchan, nbin) chunk waterfall
     disp_profile: np.ndarray | None = None    # band-averaged, dispersed
     dedisp_profile: np.ndarray | None = None  # band-averaged, dedispersed
+    # persisted-record provenance: when the candidate STORE trims the
+    # waterfall to a window around the pulse (a survey chunk's full
+    # waterfall is gigabytes — round 5), these record the window so the
+    # cutout is self-describing.  ``cutout_start`` is the cutout's
+    # first column in the searched chunk's (post-resample) samples;
+    # ``cutout_decim`` its time decimation factor.  ``nbin``/``t0``/
+    # ``istart`` keep describing the SEARCHED CHUNK, not the cutout.
+    cutout_start: int | None = None
+    cutout_decim: int | None = None
 
     # folded-period-search candidate (ops.periodicity stage)
     period_freq: float | None = None   # candidate spin frequency (Hz)

@@ -76,6 +76,44 @@ class TestTransform:
                                       use_pallas=True))
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-4)
 
+    def test_deep_pair_bit_identical(self, monkeypatch):
+        # the composed 4-parent pass (PUTPU_FDMT_DEEP_PAIR=1) must be
+        # BIT-identical to the two per-level merges it replaces: same
+        # floats, same pairwise add tree (ops/fdmt.py:_build_merge4_kernel)
+        from pulsarutils_tpu.ops import fdmt
+
+        rng = np.random.default_rng(9)
+        nchan, t = 16, 2048  # pallas path; >= 2 deep iterations
+        data = rng.normal(0, 1, (nchan, t)).astype(np.float32)
+        monkeypatch.delenv("PUTPU_FDMT_DEEP_PAIR", raising=False)
+        base = np.asarray(fdmt_transform(data, 40, GEOM[0], GEOM[1],
+                                         use_pallas=True))
+        monkeypatch.setenv("PUTPU_FDMT_DEEP_PAIR", "1")
+        fdmt._build_transform.cache_clear()
+        fdmt._transform_fn.cache_clear()
+        paired = np.asarray(fdmt_transform(data, 40, GEOM[0], GEOM[1],
+                                           use_pallas=True))
+        fdmt._build_transform.cache_clear()
+        fdmt._transform_fn.cache_clear()
+        np.testing.assert_array_equal(base, paired)
+
+    def test_deep_pair_with_pruning_bit_identical(self, monkeypatch):
+        from pulsarutils_tpu.ops import fdmt
+
+        rng = np.random.default_rng(10)
+        data = rng.normal(0, 1, (16, 2048)).astype(np.float32)
+        monkeypatch.delenv("PUTPU_FDMT_DEEP_PAIR", raising=False)
+        base = np.asarray(fdmt_transform(data, 40, GEOM[0], GEOM[1],
+                                         use_pallas=True, min_delay=17))
+        monkeypatch.setenv("PUTPU_FDMT_DEEP_PAIR", "1")
+        fdmt._build_transform.cache_clear()
+        fdmt._transform_fn.cache_clear()
+        paired = np.asarray(fdmt_transform(data, 40, GEOM[0], GEOM[1],
+                                           use_pallas=True, min_delay=17))
+        fdmt._build_transform.cache_clear()
+        fdmt._transform_fn.cache_clear()
+        np.testing.assert_array_equal(base, paired)
+
     def test_row_zero_is_plain_channel_sum(self):
         rng = np.random.default_rng(2)
         data = rng.normal(0, 1, (8, 256)).astype(np.float32)

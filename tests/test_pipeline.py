@@ -85,6 +85,38 @@ def test_search_by_chunks_resume(pulse_file, tmp_path):
         assert table.nrows > 0
 
 
+def test_save_candidate_trims_survey_scale_waterfall(tmp_path):
+    # a survey chunk's full waterfall is gigabytes; the persisted record
+    # must be a self-describing cutout around the pulse, while the
+    # in-memory info (used for plotting) stays untouched (round 5)
+    from pulsarutils_tpu.utils.table import ResultTable
+
+    nchan, nbin = 64, 1 << 18
+    wf = np.zeros((nchan, nbin), np.float32)
+    peak = 100000
+    wf[:, peak] = 5.0
+    info = PulseInfo(allprofs=wf, nbin=nbin, nchan=nchan,
+                     start_freq=1200.0, bandwidth=200.0,
+                     pulse_freq=1.0 / (nbin * 1e-3), dm=350.0, snr=20.0)
+    table = ResultTable({"DM": np.array([350.0]),
+                         "snr": np.array([20.0]),
+                         "peak": np.array([peak]),
+                         "rebin": np.array([1])})
+    store = CandidateStore(str(tmp_path), config_fingerprint(x=1))
+    base = store.save_candidate("f", 0, nbin, info, table)
+    assert info.allprofs.shape == (nchan, nbin)  # in-memory untouched
+    assert os.path.getsize(base + ".info.npz") < 2**24
+    loaded, _ = store.load_candidate("f", 0, nbin)
+    assert loaded.allprofs.shape[1] < nbin
+    assert loaded.cutout_start is not None
+    # the pulse is inside the persisted window
+    rel = peak - loaded.cutout_start
+    assert 0 <= rel // (loaded.cutout_decim or 1) < loaded.allprofs.shape[1]
+    assert loaded.allprofs.max() > 0
+    # metadata still describes the searched chunk
+    assert loaded.nbin == nbin
+
+
 def test_resume_ledger_invalidated_by_config_change(tmp_path):
     fp_a = config_fingerprint(dmmin=100, dmmax=200)
     fp_b = config_fingerprint(dmmin=100, dmmax=300)

@@ -1,0 +1,152 @@
+"""Mesh-path overhead and scaling measurements (VERDICT r4 #6).
+
+Two modes:
+
+* ``--tpu`` (run on the real chip): ``search_by_chunks`` under a
+  1-device mesh vs no mesh on identical device-staged chunks — the
+  per-chunk cost of routing through ``shard_map`` + shard-local
+  products when there is nothing to parallelise (the floor a real
+  multi-chip pod would amortise);
+* default (8-device virtual CPU mesh): scaling of the sharded hybrid
+  and the sharded plane products over 1/2/4/8 devices at a fixed
+  problem size.  CPU wall-clock does not predict TPU wall-clock, but
+  the CURVE exposes the collective/orchestration overhead the mesh
+  adds per doubling, which is the quantity the round-4 verdict asked
+  to put numbers on (``docs/distributed.md``).
+
+Usage:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/mesh_overhead_r5.py
+  python tools/mesh_overhead_r5.py --tpu
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+GEOM = (1200.0, 200.0, 0.0005)
+
+
+def _bench(fn, repeats=3):
+    fn()  # warm/compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.time()
+        fn()
+        best = min(best, time.time() - t0)
+    return best
+
+
+def cpu_scaling():
+    import jax
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.parallel.mesh import make_mesh
+    from pulsarutils_tpu.parallel.sharded_fdmt import sharded_hybrid_search
+
+    nchan, nsamp = 256, 1 << 16
+    rng = np.random.default_rng(0)
+    data = np.abs(rng.normal(0, 0.5, (nchan, nsamp))).astype(np.float32)
+    from pulsarutils_tpu.models.simulate import disperse_array
+
+    data = disperse_array(data, 350, *GEOM[:2], GEOM[2])
+    devs = jax.devices()
+    print(f"# {len(devs)} devices ({devs[0].platform})", flush=True)
+
+    rows = []
+    for n in (1, 2, 4, 8):
+        if n > len(devs):
+            break
+        mesh = make_mesh((n, 1), ("dm", "chan"))
+        dev_data = jnp.asarray(data)
+
+        def run(mesh=mesh, dev_data=dev_data):
+            t = sharded_hybrid_search(dev_data, 300.0, 400.0, *GEOM,
+                                      mesh=mesh)
+            np.asarray(t["snr"][:1])
+
+        best = _bench(run)
+        rows.append((n, best))
+        base = rows[0][1]
+        print(f"sharded hybrid  n={n}:  {best:7.3f}s  "
+              f"speedup {base / best:4.2f}x  efficiency "
+              f"{base / best / n:4.2f}", flush=True)
+
+    # sharded plane products at fixed size
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from pulsarutils_tpu.parallel.sharded_plane import ShardedPlane
+
+    ndm, t_len = 512, 1 << 16
+    plane_host = np.abs(rng.normal(0, 1, (ndm, t_len))).astype(np.float32)
+    for n in (1, 2, 4, 8):
+        if n > len(devs):
+            break
+        mesh = Mesh(np.array(devs[:n]), ("dm",))
+        plane = jax.device_put(
+            plane_host, NamedSharding(mesh, P("dm", None)))
+        spl = ShardedPlane(plane, mesh, "dm", row_index=np.arange(ndm))
+
+        def run(spl=spl):
+            h, _ = spl.h_curve(window=2)
+            np.asarray(h[:1])
+
+        best = _bench(run)
+        if n == 1:
+            base_p = best
+        print(f"plane h_curve   n={n}:  {best:7.3f}s  "
+              f"speedup {base_p / best:4.2f}x", flush=True)
+
+
+def tpu_mesh_floor():
+    import jax
+
+    import bench
+    from pulsarutils_tpu.ops.search import dedispersion_search
+    from pulsarutils_tpu.parallel.sharded_fdmt import sharded_hybrid_search
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_bench"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
+
+    nchan, nsamp = 1024, 1 << 20
+    array = bench.make_data(nchan, nsamp)
+    dev, up_s = bench.upload(array)
+    print(f"# upload {up_s:.1f}s", flush=True)
+
+    best_plain = _bench(lambda: dedispersion_search(
+        dev, 300.0, bench.DMMAX, *GEOM, backend="jax", kernel="hybrid"))
+    print(f"hybrid, no mesh:       {best_plain:7.3f}s "
+          f"({513 / best_plain:6.1f} tr/s)", flush=True)
+
+    from pulsarutils_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((1, 1), ("dm", "chan"))
+    best_mesh = _bench(lambda: sharded_hybrid_search(
+        dev, 300.0, bench.DMMAX, *GEOM, mesh=mesh))
+    print(f"hybrid, 1-device mesh: {best_mesh:7.3f}s "
+          f"({513 / best_mesh:6.1f} tr/s)  overhead "
+          f"{best_mesh - best_plain:+.3f}s", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--tpu", action="store_true")
+    opts = p.parse_args(argv)
+    if opts.tpu:
+        tpu_mesh_floor()
+    else:
+        cpu_scaling()
+
+
+if __name__ == "__main__":
+    main()
