@@ -90,13 +90,24 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
     :func:`~pulsarutils_tpu.pipeline.search_pipeline.search_by_chunks`
     (``(istart, iend, PulseInfo, ResultTable)`` tuples).
 
-    Default radii: ``time_radius`` = 1.5 chunk spans — duplicate
-    detections from the 50% overlap land within one hop, and chunks
-    holding only part of a pulse detect its *circular-wrap artifact* up
-    to a chunk span (+ its width) away (the roll convention wraps the
-    dispersed tail, reference ``dedispersion.py:60-98``); ``dm_radius`` =
-    per group, 2% of the group seed's DM + 1 (trial-grid neighbours and
-    chunk-to-chunk jitter — see :func:`sift_candidates`).
+    Default radii: when every hit carries an EXACT arrival time (the
+    ``peak`` column), duplicates from the 50% chunk overlap land at the
+    *same* time up to boxcar rounding, so ``time_radius`` is
+    width-scale — ``max(0.5 s, 4x the widest hit)``.  A chunk-scale
+    radius here is actively wrong at survey chunk sizes: two REAL
+    pulses minutes apart merged into one candidate (round-5 survey
+    rehearsal, 2 GB file — the sift swallowed a DM-394 pulse 555 s
+    from a DM-395 one because 1.5 chunk spans was 786 s).  Hits with
+    only approximate times (``time_approx``, legacy tables without a
+    peak column) keep the old 1.5-chunk-span radius, which their
+    chunk-start-quantised times genuinely need.  A chunk holding only
+    part of a pulse can still report its *circular-wrap artifact* as a
+    separate weaker candidate (the roll convention wraps the dispersed
+    tail, reference ``dedispersion.py:60-98``) — the overlapping
+    neighbour that contains the pulse outright outranks it, and keeping
+    the artifact visible beats merging distinct pulses.  ``dm_radius``
+    = per group, 2% of the group seed's DM + 1 (trial-grid neighbours
+    and chunk-to-chunk jitter — see :func:`sift_candidates`).
 
     Returns a list of candidate dicts (descending S/N) with keys
     ``time, dm, snr, width, istart, iend, n_members, info, table``.
@@ -105,5 +116,8 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
         return []
     cands = [hit_fields(*h) for h in hits]
     if time_radius is None:
-        time_radius = 1.5 * max(c["span"] for c in cands)
+        if any(c["time_approx"] for c in cands):
+            time_radius = 1.5 * max(c["span"] for c in cands)
+        else:
+            time_radius = max(0.5, 4.0 * max(c["width"] for c in cands))
     return sift_candidates(cands, time_radius, dm_radius)

@@ -72,6 +72,52 @@ def test_sift_hits_collapses_overlap_duplicates(tmp_path):
     assert abs(best["time"] - t_true) <= 0.05
 
 
+def test_sift_keeps_distinct_pulses_within_one_chunk_span():
+    # two REAL pulses minutes apart (well within one survey chunk span)
+    # must stay separate candidates when arrival times are exact — the
+    # round-5 rehearsal lost a pulse to the old chunk-scale radius
+    from pulsarutils_tpu.pipeline.sift import sift_candidates, sift_hits
+
+    span = 524.0  # survey chunk span, seconds
+    cands = []
+    for t, dm, snr in ((3035.96, 394.9, 27.1), (3035.96, 394.9, 27.0),
+                       (3590.62, 394.2, 21.1), (3590.62, 394.2, 21.0)):
+        cands.append({"time": t, "dm": dm, "snr": snr, "width": 2e-3,
+                      "span": span, "time_approx": False})
+    # exact-time default radius: width-scale, so the two pulses survive
+    radius = max(0.5, 4.0 * max(c["width"] for c in cands))
+    kept = sift_candidates(cands, radius)
+    assert len(kept) == 2
+    times = sorted(round(k["time"], 2) for k in kept)
+    assert times == [3035.96, 3590.62]
+    assert all(k["n_members"] == 2 for k in kept)
+
+    # and sift_hits picks that radius when no hit is time-approximate
+    class _T:
+        colnames = ("DM", "snr", "rebin", "peak")
+
+        def __init__(self, dm, snr, peak):
+            self._r = {"DM": dm, "snr": snr, "rebin": 2, "peak": peak}
+
+        def best_row(self):
+            return self._r
+
+        def __getitem__(self, k):
+            return self._r[k]
+
+    class _I:
+        nbin = 524288
+        pulse_freq = 1.0 / 524.288  # tsamp 1e-3
+
+        def __init__(self, t0):
+            self.t0 = t0
+
+    hits = [(0, 10, _I(3000.0), _T(394.9, 27.1, 35960)),
+            (5, 15, _I(3000.0), _T(394.2, 21.1, 590620))]
+    sifted = sift_hits(hits)
+    assert len(sifted) == 2
+
+
 def test_pucands_lists_and_exports(tmp_path):
     # end to end: search -> store -> PUcands listing + CSV export
     import csv

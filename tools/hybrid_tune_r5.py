@@ -85,6 +85,15 @@ def main(argv=None):
     os.environ.pop("PUTPU_PALLAS_SCORE", None)
     print(f"# scorer saving: {t_fdmt_old - t_fdmt_new:+.3f}s", flush=True)
 
+    # --- 1b. deep-level pairing A/B (VERDICT r4 #3) ------------------
+    os.environ["PUTPU_FDMT_DEEP_PAIR"] = "1"
+    t_fdmt_pair = measure("fdmt", "fdmt coarse, deep pair + scorer")[1]
+    os.environ.pop("PUTPU_FDMT_DEEP_PAIR", None)
+    print(f"# deep-pair saving: {t_fdmt_new - t_fdmt_pair:+.3f}s",
+          flush=True)
+    if t_fdmt_pair < t_fdmt_new:
+        os.environ["PUTPU_FDMT_DEEP_PAIR"] = "1"  # adopt for the sweep
+
     # --- 2. hybrid tuning sweep --------------------------------------
     results = {}
     for seed_bucket in (8, 6):

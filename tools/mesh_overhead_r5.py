@@ -44,6 +44,11 @@ def _bench(fn, repeats=3):
 
 def cpu_scaling():
     import jax
+
+    # the axon sitecustomize overrides JAX_PLATFORMS at interpreter
+    # start; only the config update (before any jax.devices()) actually
+    # forces the CPU backend (verify-skill gotcha)
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from pulsarutils_tpu.parallel.mesh import make_mesh
