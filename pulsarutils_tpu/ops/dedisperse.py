@@ -165,8 +165,16 @@ def dedisperse_block_chunked_jax(data, offsets, chan_block=None):
     data_b = data.reshape(nblocks, chan_block, t)
     off_b = offsets.reshape(ndm, nblocks, chan_block).transpose(1, 0, 2)
 
+    del ndm
+
     def body(i, acc):
         return acc + dedisperse_block_jax(data_b[i], off_b[i])
 
-    acc0 = jnp.zeros((ndm, t), dtype=data.dtype)
-    return jax.lax.fori_loop(0, nblocks, body, acc0)
+    # the carry is seeded with block 0 (not zeros): under shard_map a
+    # zeros-constant carry is UNVARYING while the body's sum is varying
+    # over the mesh axes, and lax.fori_loop rejects the carry-type
+    # mismatch (hit live on a (n, 1) mesh whose per-device gather
+    # exceeded the chan_block budget — round 5).  Bit-identical:
+    # 0 + b0 == b0 in f32.
+    acc0 = dedisperse_block_jax(data_b[0], off_b[0])
+    return jax.lax.fori_loop(1, nblocks, body, acc0)

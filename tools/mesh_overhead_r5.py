@@ -43,11 +43,16 @@ def _bench(fn, repeats=3):
 
 
 def cpu_scaling():
+    # 8 virtual CPU devices: the flag must precede backend init, and
+    # the platform must be forced via config (the axon sitecustomize
+    # overrides JAX_PLATFORMS at interpreter start — verify-skill
+    # gotcha)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
-    # the axon sitecustomize overrides JAX_PLATFORMS at interpreter
-    # start; only the config update (before any jax.devices()) actually
-    # forces the CPU backend (verify-skill gotcha)
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
@@ -64,14 +69,15 @@ def cpu_scaling():
     print(f"# {len(devs)} devices ({devs[0].platform})", flush=True)
 
     rows = []
+    del jnp  # the search owns device placement (a pre-uploaded
+    # unsharded array trips shard_map's varying-axes check)
     for n in (1, 2, 4, 8):
         if n > len(devs):
             break
         mesh = make_mesh((n, 1), ("dm", "chan"))
-        dev_data = jnp.asarray(data)
 
-        def run(mesh=mesh, dev_data=dev_data):
-            t = sharded_hybrid_search(dev_data, 300.0, 400.0, *GEOM,
+        def run(mesh=mesh):
+            t = sharded_hybrid_search(data, 300.0, 400.0, *GEOM,
                                       mesh=mesh)
             np.asarray(t["snr"][:1])
 
