@@ -175,6 +175,12 @@ def config5(quick):
       searched, timed end-to-end (the tunnel runs 15-380 s / 4 GB, so the
       full 8-chunk link-bound pass is impractical and was the round-1
       gap; one chunk characterises the rate honestly).
+
+    The REAL on-disk streaming measurement — native 2-bit file, packed
+    upload, CLI, resume, certificate — is the round-5 survey rehearsal
+    (``docs/survey_rehearsal_r5.md``), which supersedes this config as
+    the end-to-end evidence; this config remains the compute-bound
+    ceiling measurement.
     """
     import jax
     import jax.numpy as jnp

@@ -51,8 +51,40 @@ FDD_L = 1024
 FDD_C_BLOCK = 8
 
 
+def _batch_carry():
+    """PUTPU_FDD_BATCH_CARRY: channel-group size of the batched carry
+    (''/0 = off, the per-channel form; 2/4/8 = group size).
+
+    The per-(channel, trial) output accumulate is the kernel's VMEM
+    traffic hot spot (~4.4 TB of out read+write per canonical sweep);
+    batching ``g`` channels into one (g, 8, L) re/im carry divides it
+    by ``g`` at the cost of ``16 * g`` vregs of loop state.  Round-5
+    A/B (v5e, canonical 513-trial 1024 x 1M sweep, min-of-4): g=8 —
+    the full block — MEASURED SLOWER (233 -> 180 tr/s; ~128 vregs of
+    carry against a ~64-vreg register file spills on every rotation,
+    the fused head's 16-row-unroll pathology); the measured middle
+    ground is recorded in docs/performance.md.
+    """
+    import os
+
+    raw = os.environ.get("PUTPU_FDD_BATCH_CARRY", "")
+    try:
+        value = int(raw or 0)
+    except ValueError:
+        value = 0
+    if raw and value not in (0, 2, 4, 8):
+        import warnings
+
+        warnings.warn(f"PUTPU_FDD_BATCH_CARRY={raw!r} ignored (expected "
+                      "0/2/4/8); using the per-channel form",
+                      stacklevel=2)
+        value = 0
+    return value if value in (2, 4, 8) else 0
+
+
 @functools.lru_cache(maxsize=8)
-def _build_fdd_kernel(n_tiles, superblock, n_cblocks, c_block, interpret):
+def _build_fdd_kernel(n_tiles, superblock, n_cblocks, c_block, interpret,
+                      batch_carry=False):
     """out[n] = sum_c u_c * step_c^n over one superblock of trials.
 
     Shapes (all float32): ``u_re/u_im/s_re/s_im (nchan_p, n_tiles, 8, L)``
@@ -75,29 +107,47 @@ def _build_fdd_kernel(n_tiles, superblock, n_cblocks, c_block, interpret):
             outre[:] = jnp.zeros_like(outre)
             outim[:] = jnp.zeros_like(outim)
 
-        # the whole channel block rides the loop state as ONE
-        # (c_block, 8, L) re/im pair: the rotation issues 6 vector ops
-        # over the batched tile instead of 6 per channel, and the
-        # dynamically-indexed output accumulate — the per-step cost
-        # that dominated the channel-inner form (round 5: 2.20 s ->
-        # measured below) — happens once per trial instead of once per
-        # (channel, trial), with the channel sum folded in registers
-        sr = sre[:, 0]                        # (c_block, 8, L)
-        si = sim[:, 0]
+        if batch_carry:
+            # (g, 8, L) re/im carries: one output accumulate per trial
+            # per channel GROUP instead of per channel (see
+            # _batch_carry for the measured trade)
+            g = min(batch_carry, c_block)
+            for c0 in range(0, c_block, g):
+                sr = sre[c0:c0 + g, 0]
+                si = sim[c0:c0 + g, 0]
 
-        def body(nb, carry):
-            cr, ci = carry
-            for dn in range(FDD_N_UNROLL):
-                n = nb * FDD_N_UNROLL + dn
-                outre[n, 0] += jnp.sum(cr, axis=0)
-                outim[n, 0] += jnp.sum(ci, axis=0)
-                nr = cr * sr - ci * si
-                ci = cr * si + ci * sr
-                cr = nr
-            return cr, ci
+                def body(nb, carry, sr=sr, si=si):
+                    cr, ci = carry
+                    for dn in range(FDD_N_UNROLL):
+                        n = nb * FDD_N_UNROLL + dn
+                        outre[n, 0] += jnp.sum(cr, axis=0)
+                        outim[n, 0] += jnp.sum(ci, axis=0)
+                        nr = cr * sr - ci * si
+                        ci = cr * si + ci * sr
+                        cr = nr
+                    return cr, ci
 
-        jax.lax.fori_loop(0, superblock // FDD_N_UNROLL, body,
-                          (ure[:, 0], uim[:, 0]))
+                jax.lax.fori_loop(0, superblock // FDD_N_UNROLL, body,
+                                  (ure[c0:c0 + g, 0], uim[c0:c0 + g, 0]))
+            return
+
+        for c in range(c_block):
+            sr = sre[c, 0]
+            si = sim[c, 0]
+
+            def body(nb, carry, sr=sr, si=si):
+                cr, ci = carry
+                for dn in range(FDD_N_UNROLL):
+                    n = nb * FDD_N_UNROLL + dn
+                    outre[n, 0] += cr
+                    outim[n, 0] += ci
+                    nr = cr * sr - ci * si
+                    ci = cr * si + ci * sr
+                    cr = nr
+                return cr, ci
+
+            jax.lax.fori_loop(0, superblock // FDD_N_UNROLL, body,
+                              (ure[c, 0], uim[c, 0]))
 
     in_spec = pl.BlockSpec((c_block, 1, 8, L),
                            lambda i_f, i_c: (i_c, i_f, 0, 0))
@@ -146,7 +196,7 @@ def fdd_superblock_spectra(u, step, superblock, interpret=False):
         return z.reshape(nchan_p, n_tiles, 8, FDD_L)
 
     run = _build_fdd_kernel(n_tiles, int(superblock), n_cblocks, c_block,
-                            bool(interpret))
+                            bool(interpret), batch_carry=_batch_carry())
     out_re, out_im = run(prep(jnp.real(u).astype(jnp.float32)),
                          prep(jnp.imag(u).astype(jnp.float32)),
                          prep(jnp.real(step).astype(jnp.float32)),

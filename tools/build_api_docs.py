@@ -22,6 +22,12 @@ MODULES = [
     "pulsarutils_tpu.ops.pallas_dedisperse",
     "pulsarutils_tpu.ops.fdmt",
     "pulsarutils_tpu.ops.fourier",
+    "pulsarutils_tpu.ops.fdmt_resident",
+    "pulsarutils_tpu.ops.score_pallas",
+    "pulsarutils_tpu.ops.fourier_pallas",
+    "pulsarutils_tpu.ops.certify",
+    "pulsarutils_tpu.parallel.sharded_plane",
+    "pulsarutils_tpu.utils.knobs",
     "pulsarutils_tpu.ops.clean_ops",
     "pulsarutils_tpu.ops.robust",
     "pulsarutils_tpu.ops.rebin",
