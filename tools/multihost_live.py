@@ -1,0 +1,97 @@
+"""Live two-process multihost check (VERDICT r4 weak #5).
+
+``parallel/multihost.py`` had only ever executed in single-process
+degraded mode — every multi-process branch was faith-based.  This
+driver runs a REAL two-process JAX cluster over loopback "DCN": the
+parent spawns two ranks (4 virtual CPU devices each), rank 0 hosts the
+coordinator, both call ``multihost.initialize`` explicitly, build the
+``pod_mesh`` (dm spans processes, chan stays in-process), run the
+sharded sweep on a replicated input, and verify the result against the
+single-process NumPy reference.
+
+Usage: python tools/multihost_live.py            # parent / orchestrator
+       (ranks are spawned internally with _RANK set)
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PORT = 38921
+NPROC = 2
+GEOM = (1200.0, 200.0, 0.001)
+
+
+def rank_main(rank):
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pulsarutils_tpu.parallel import multihost
+
+    multi = multihost.initialize(
+        coordinator_address=f"127.0.0.1:{PORT}", num_processes=NPROC,
+        process_id=rank)
+    assert multi, "initialize() reported single-process"
+    assert jax.process_count() == NPROC, jax.process_count()
+    assert jax.local_device_count() == 4
+    assert len(jax.devices()) == 8  # the global mesh sees both ranks
+
+    import numpy as np
+
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.ops.search import dedispersion_search
+    from pulsarutils_tpu.parallel import sharded
+
+    # identical (replicated) input on both ranks — standard SPMD contract
+    array, header = simulate_test_data(150, nchan=32, nsamples=2048,
+                                       signal=2.0, noise=0.4, rng=77)
+    args = (100, 200.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+
+    mesh = multihost.pod_mesh()
+    assert mesh.devices.size == 8
+    table = sharded.sharded_dedispersion_search(np.asarray(array), *args,
+                                                mesh=mesh)
+    ref = dedispersion_search(np.asarray(array), *args, backend="numpy")
+    assert table.nrows == ref.nrows
+    best, best_ref = table.argbest("snr"), ref.argbest("snr")
+    assert best == best_ref, (best, best_ref)
+    assert np.allclose(np.asarray(table["snr"]), np.asarray(ref["snr"]),
+                       rtol=1e-4, atol=1e-4)
+    print(f"rank {rank}: process_count={jax.process_count()} "
+          f"global_devices={len(jax.devices())} "
+          f"mesh={dict(mesh.shape)} argbest DM="
+          f"{float(table['DM'][best]):.2f} == numpy reference OK",
+          flush=True)
+
+
+def main():
+    rank = os.environ.get("PUTPU_MULTIHOST_RANK")
+    if rank is not None:
+        rank_main(int(rank))
+        return 0
+
+    procs = []
+    for r in range(NPROC):
+        env = dict(os.environ, PUTPU_MULTIHOST_RANK=str(r),
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4")
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    rc = 0
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        tail = "\n".join(out.strip().splitlines()[-3:])
+        print(f"--- rank {r} (rc={p.returncode}) ---\n{tail}", flush=True)
+        rc |= p.returncode
+    print("MULTIHOST LIVE:", "OK" if rc == 0 else "FAILED", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
