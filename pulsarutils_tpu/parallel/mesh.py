@@ -75,3 +75,25 @@ def pad_to_multiple(array, axis, multiple, mode="edge"):
     widths[axis] = (0, pad)
     kwargs = {} if mode != "constant" else {"constant_values": 0}
     return np.pad(array, widths, mode=mode, **kwargs), n
+
+
+def fetch_global(arr):
+    """Global (possibly multi-process-sharded) jax array -> host numpy.
+
+    On a multi-process cluster a globally-sharded array spans devices
+    the local process cannot address and plain ``np.asarray`` raises —
+    found live by ``tools/multihost_live.py`` (round 5).
+    ``process_allgather`` assembles the full value on every host;
+    single-process keeps the zero-copy fetch.  Safe on plain
+    numpy/host inputs.
+    """
+    import numpy as np
+
+    import jax
+
+    if isinstance(arr, jax.Array) and jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr,
+                                                            tiled=True))
+    return np.asarray(arr)

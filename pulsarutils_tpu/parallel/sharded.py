@@ -162,24 +162,7 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     out = compiled(jnp.asarray(data_padded, dtype=dtype),
                    jnp.asarray(offsets), jnp.int32(roll_k))
 
-    def fetch(arr):
-        """Global array -> host numpy, multihost-safe.
-
-        On a multi-process cluster the global array spans devices this
-        process cannot address, and a plain ``np.asarray`` raises —
-        found live by ``tools/multihost_live.py`` (round 5, the first
-        time any multi-process branch actually executed).
-        ``process_allgather`` assembles the full value on every host;
-        single-process keeps the zero-copy fetch.
-        """
-        import jax
-
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            return np.asarray(
-                multihost_utils.process_allgather(arr, tiled=True))
-        return np.asarray(arr)
+    from .mesh import fetch_global as fetch
 
     if capture_plane:
         stacked, plane = out

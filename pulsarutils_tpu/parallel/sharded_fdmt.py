@@ -41,6 +41,7 @@ from ..ops.fdmt import (
     fdmt_trial_dms,
 )
 from ..utils.table import ResultTable
+from .mesh import fetch_global
 
 __all__ = ["sharded_fdmt_search", "sharded_hybrid_search",
            "slice_delay_range"]
@@ -231,7 +232,7 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
         from .sharded_plane import ShardedPlane
 
         out, plane = fn(data, *flat)
-        out = np.asarray(out)
+        out = fetch_global(out)
         # device d's padded shard starts at d * rows_max in the global
         # concatenated plane; its first (hi-lo+1) rows are its slice
         rows_max = plane.shape[0] // n_dev
@@ -240,7 +241,7 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
              for d, (lo, hi) in enumerate(slices)])
         plane_handle = ShardedPlane(plane, mesh, axis, row_index)
     else:
-        out = np.asarray(fn(data, *flat))
+        out = fetch_global(fn(data, *flat))
 
     # stitch the dm-sharded scores: device d's first (hi-lo+1) rows are
     # its delay slice; the rest is padding junk

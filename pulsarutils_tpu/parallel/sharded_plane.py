@@ -157,7 +157,9 @@ class ShardedPlane:
 
     def row(self, i):
         """One table row as a host float array (fetches ~T floats)."""
-        return np.asarray(self._plane[int(self.row_index[int(i)])])
+        from .mesh import fetch_global
+
+        return fetch_global(self._plane[int(self.row_index[int(i)])])
 
     def __getitem__(self, i):
         if not np.isscalar(i) and not isinstance(i, (int, np.integer)):
@@ -169,7 +171,9 @@ class ShardedPlane:
         """Materialise the FULL plane on host, table-row order (tests and
         small-plane interop only — this is the gather the handle exists
         to avoid)."""
-        return np.asarray(self._plane)[self.row_index]
+        from .mesh import fetch_global
+
+        return fetch_global(self._plane)[self.row_index]
 
     # -- shard-local products -------------------------------------------
 
@@ -187,7 +191,9 @@ class ShardedPlane:
                                 None if fmax is None else float(fmax))
         from ..ops.periodicity import _SPEC_KEYS
 
-        stacked = np.asarray(run(self._plane))[:, self.row_index]
+        from .mesh import fetch_global
+
+        stacked = fetch_global(run(self._plane))[:, self.row_index]
         out = dict(zip(_SPEC_KEYS, stacked))
         out["nharm"] = np.rint(out["nharm"]).astype(np.int32)
         return out
@@ -209,9 +215,11 @@ class ShardedPlane:
 
         valid = np.zeros(int(self._plane.shape[0]), dtype=bool)
         valid[np.unique(self.row_index)] = True
+        from .mesh import fetch_global
+
         h, m = run(self._plane, jnp.asarray(valid))
-        return (np.asarray(h)[self.row_index],
-                np.asarray(m)[self.row_index])
+        return (fetch_global(h)[self.row_index],
+                fetch_global(m)[self.row_index])
 
     def decimated(self, max_bins=2048):
         """Time-decimated plane image for the figure's plane panel.
@@ -227,5 +235,7 @@ class ShardedPlane:
             # is the identity, and at <= max_bins columns the gather is
             # by definition within the decimated-image budget
             return self.to_host(), 1
+        from .mesh import fetch_global
+
         run = _decim_program(self.mesh, self.axis, factor)
-        return np.asarray(run(self._plane))[self.row_index], factor
+        return fetch_global(run(self._plane))[self.row_index], factor
