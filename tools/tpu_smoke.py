@@ -202,6 +202,75 @@ def _fdmt_head():
         np.abs(outs[0] - outs[1]).max())
 
 
+@check("fdmt: paired deep merge bit-identical on hardware (round 5)")
+def _fdmt_deep_pair():
+    import os
+
+    import numpy as np
+
+    from pulsarutils_tpu.ops import fdmt
+
+    nchan, t = 64, 1 << 13
+    rng = np.random.default_rng(11)
+    data = rng.normal(0, 1, (nchan, t)).astype(np.float32)
+    outs = []
+    for knob in ("0", "1"):
+        os.environ["PUTPU_FDMT_DEEP_PAIR"] = knob
+        fdmt._build_transform.cache_clear()
+        fdmt._transform_fn.cache_clear()
+        outs.append(np.asarray(fdmt.fdmt_transform(
+            data, 50, 1200.0, 200.0, use_pallas=True)))
+    os.environ.pop("PUTPU_FDMT_DEEP_PAIR", None)
+    fdmt._build_transform.cache_clear()
+    fdmt._transform_fn.cache_clear()
+    assert np.array_equal(outs[0], outs[1]), float(
+        np.abs(outs[0] - outs[1]).max())
+
+
+@check("one-pass Pallas plane scorer == XLA scorer on hardware (round 5)")
+def _score_kernel():
+    import numpy as np
+
+    from pulsarutils_tpu.ops.score_pallas import score_plane_pallas
+    from pulsarutils_tpu.ops.search import score_profiles_chunked
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(12)
+    plane = rng.standard_normal((40, 1 << 14)).astype(np.float32)
+    plane[7, 5000:5004] += 6.0
+    got = np.asarray(score_plane_pallas(jnp.asarray(plane),
+                                        with_cert=True))
+    want = np.asarray(score_profiles_chunked(jnp.asarray(plane), jnp,
+                                             with_cert=True))
+    np.testing.assert_allclose(got[:3], want[:3], rtol=2e-4, atol=1e-5)
+    np.testing.assert_array_equal(got[3], want[3])  # window
+    np.testing.assert_array_equal(got[4], want[4])  # peak
+    np.testing.assert_allclose(got[5], want[5], rtol=2e-4, atol=1e-5)
+
+
+@check("FDD carry-group variants agree on hardware (round 5)")
+def _fdd_variants():
+    import os
+
+    import numpy as np
+
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    array, header = simulate_test_data(150, nchan=32, nsamples=4096, rng=13)
+    args = (120, 180.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+    outs = []
+    for knob in ("0", "2"):
+        os.environ["PUTPU_FDD_BATCH_CARRY"] = knob
+        t = dedispersion_search(np.asarray(array), *args, backend="jax",
+                                kernel="fourier")
+        outs.append(np.asarray(t["snr"]))
+    os.environ.pop("PUTPU_FDD_BATCH_CARRY", None)
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-4, atol=1e-4)
+
+
 @check("fdmt: odd-length time axis (zero-pad path)")
 def _fdmt_odd():
     import numpy as np
