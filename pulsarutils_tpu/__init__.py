@@ -138,6 +138,9 @@ _LAZY = {
     "cert_miss_p_at_floor": ("ops.certify", "cert_miss_p_at_floor"),
     # disk-backed plane capture (round 4)
     "plane_memmap": ("ops.search", "plane_memmap"),
+    # streaming wall-clock budget accountant (round 6)
+    "BudgetAccountant": ("utils.logging_utils", "BudgetAccountant"),
+    "measure_device_rtt": ("utils.logging_utils", "measure_device_rtt"),
 }
 
 

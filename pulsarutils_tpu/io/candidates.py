@@ -91,20 +91,33 @@ class CandidateStore:
     def save_candidate(self, root, istart, iend, info: PulseInfo,
                        table: ResultTable):
         base = self._base(root, istart, iend)
-        self._trim_waterfall(info, table).save(base + ".info.npz")
+        self.trim_waterfall(info, table).save(base + ".info.npz")
         table.to_npz(base + ".table.npz")
         return base
 
-    def _trim_waterfall(self, info, table):
+    def trim_waterfall(self, info, table):
         """Bound the persisted record: full chunk in, pulse cutout out.
 
         The window covers the dispersed track — ``[peak - pad,
         peak + span + pad]`` with ``span`` the band-crossing delay at
         the candidate's DM — then block-sum decimates if still over
-        budget.  The in-memory ``info`` (diagnostics plotting, the
-        returned hits list) is untouched; only the persisted copy is
-        trimmed, with ``cutout_start``/``cutout_decim`` recording the
-        window (see :class:`..pipeline.pulse_info.PulseInfo`).
+        budget.  The passed ``info`` is untouched (a trimmed *copy* is
+        returned, or ``info`` itself when already under budget), with
+        ``cutout_start``/``cutout_decim`` recording the window (see
+        :class:`..pipeline.pulse_info.PulseInfo`).
+
+        Tracks wrapping the chunk end are followed circularly (round 6,
+        ADVICE r5): the search's roll convention wraps a dispersed tail
+        past the chunk end to the chunk start, so for a pulse near the
+        end the informative columns live at BOTH edges — the window is
+        taken mod ``nbin`` (``cutout_start`` may therefore exceed
+        ``nbin - width``; consumers recover absolute columns as
+        ``(cutout_start + j * cutout_decim) mod nbin``).
+
+        ``info.allprofs`` may be a device (jnp) array: the window is
+        sliced device-side, so only the cutout — not the multi-GB chunk
+        — crosses the host link (the streaming driver relies on this,
+        round 6).
         """
         import dataclasses
 
@@ -126,9 +139,20 @@ class CandidateStore:
                                    info.start_freq + info.bandwidth)
                        / tsamp) + 1
         pad = max(span // 2, 256)
-        lo = max(0, peak - pad)
-        hi = min(nbin, peak + span + pad)
-        cut = np.asarray(wf[:, lo:hi])
+        lo = peak - pad
+        hi = peak + span + pad
+        if hi - lo >= nbin:  # window covers the whole chunk
+            lo, hi = 0, nbin
+        if lo >= 0 and hi <= nbin:
+            cut = np.asarray(wf[:, lo:hi])
+        else:
+            # circular window: the dispersed tail wrapped past an edge
+            cols = np.arange(lo, hi) % nbin
+            if isinstance(wf, np.ndarray):
+                cut = np.take(wf, cols, axis=1, mode="wrap")
+            else:  # device array: gather on device, read back the window
+                cut = np.asarray(wf[:, cols])
+            lo = lo % nbin
         decim = 1
         if cut.size > self.WATERFALL_BUDGET:
             from ..ops.rebin import quick_resample
@@ -137,6 +161,9 @@ class CandidateStore:
             cut = np.asarray(quick_resample(cut, decim))
         return dataclasses.replace(info, allprofs=cut, cutout_start=lo,
                                    cutout_decim=decim)
+
+    # backward-compatible alias (pre-round-6 name)
+    _trim_waterfall = trim_waterfall
 
     def load_candidate(self, root, istart, iend):
         base = self._base(root, istart, iend)

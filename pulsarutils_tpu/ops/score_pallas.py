@@ -274,6 +274,19 @@ def score_enabled():
     return tristate_env("PUTPU_PALLAS_SCORE")
 
 
+def _kernel_scores(rows_p, t, t_blk, with_cert, interpret, sub):
+    """Run the one-pass kernel on the 8-aligned row block ``sub``.
+
+    Split out of :func:`score_plane_pallas` so tests can stub the
+    (expensive) kernel invocation while exercising the wrapper's
+    checks (the 2^24 peak-exactness warning below).
+    """
+    import jax.numpy as jnp
+
+    return _build_score_kernel(rows_p, t, t_blk, with_cert, interpret)(
+        jnp.asarray(sub, jnp.float32))
+
+
 def score_plane_pallas(plane, with_cert=False, interpret=False):
     """One-pass scores of ``plane`` — drop-in for
     :func:`..ops.search.score_profiles_chunked` on tile-friendly shapes.
@@ -283,6 +296,13 @@ def score_plane_pallas(plane, with_cert=False, interpret=False):
     ``ValueError`` when no supported tile divides the time axis — the
     caller falls back to the XLA scorer.
 
+    Peak indices are accumulated as float32 in the kernel (the global
+    argmax slot is ``tile_arg + t_blk * i_t``), exact only below 2^24
+    samples — the same float32-pack limit as
+    :func:`..ops.search.score_profiles_stacked`, and the same warning
+    fires above it (ADVICE r5: this path previously accepted e.g. a
+    tile-divisible 2^25 silently while the XLA scorer warned).
+
     Row counts are handled without any plane-sized copy (the motivating
     coarse plane is 513 x 1M — an odd row count; padding it would
     re-materialise ~2 GB per search, code-review r5): the 8-aligned
@@ -291,16 +311,22 @@ def score_plane_pallas(plane, with_cert=False, interpret=False):
     """
     import jax.numpy as jnp
 
+    from .search import warn_peak_exactness
+
     rows, t = plane.shape
     t_blk = pick_score_tile(t)
     if t_blk == 0:
         raise ValueError(f"no supported score tile divides T={t}")
     rows8 = (rows // 8) * 8
+    if rows8 == rows:
+        # remainder rows (below) route through the XLA stacked scorer,
+        # whose own warn_peak_exactness covers the call — warning here
+        # too would fire twice for one call (code-review r6)
+        warn_peak_exactness(t)
     parts = []
     if rows8:
-        out = _build_score_kernel(rows8, t, t_blk, bool(with_cert),
-                                  bool(interpret))(
-            jnp.asarray(plane[:rows8], jnp.float32))
+        out = _kernel_scores(rows8, t, t_blk, bool(with_cert),
+                             bool(interpret), plane[:rows8])
         parts.append(out[:, :6 if with_cert else 5].T)
     if rows8 != rows:
         from .search import score_profiles_chunked

@@ -98,7 +98,7 @@ def iter_chunk_starts(nsamples, plan, tmin=0, sample_time=None):
 
 def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   *, backend="jax", snr_threshold=6.0, trial_dms=None,
-                  dm_block=None, chan_block=None):
+                  dm_block=None, chan_block=None, budget=None):
     """Search an iterable of ``(istart, (nchan, step))`` chunks.
 
     One compiled executable serves every distinct chunk shape; interior
@@ -108,18 +108,34 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     ``(istart, table, best_row)`` for chunks whose best S/N clears
     ``snr_threshold`` (the reference's candidate criterion,
     ``clean.py:349``), plus the full tables for diagnostics.
+
+    ``budget`` (a
+    :class:`~pulsarutils_tpu.utils.logging_utils.BudgetAccountant`)
+    opens one chunk budget per chunk: the search's dispatch/readback
+    buckets land per chunk, and a compile observed on any chunk after
+    the first is flagged as a retrace (the one-executable contract above
+    is *checked*, not assumed — round 6).
     """
+    import contextlib
+
+    if budget is not None:
+        budget.begin_stream()
     results = []
     hits = []
     for istart, chunk in chunks:
-        table = dedispersion_search(chunk, dmmin, dmmax, start_freq,
-                                    bandwidth, sample_time, backend=backend,
-                                    trial_dms=trial_dms, dm_block=dm_block,
-                                    chan_block=chan_block)
-        results.append((istart, table))
-        best = table.best_row()
-        if best["snr"] > snr_threshold:
-            hits.append((istart, table, best))
+        ctx = (budget.chunk(istart) if budget is not None
+               else contextlib.nullcontext())
+        with ctx:
+            with (budget.bucket("search") if budget is not None
+                  else contextlib.nullcontext()):
+                table = dedispersion_search(
+                    chunk, dmmin, dmmax, start_freq, bandwidth,
+                    sample_time, backend=backend, trial_dms=trial_dms,
+                    dm_block=dm_block, chan_block=chan_block)
+            results.append((istart, table))
+            best = table.best_row()
+            if best["snr"] > snr_threshold:
+                hits.append((istart, table, best))
     return results, hits
 
 

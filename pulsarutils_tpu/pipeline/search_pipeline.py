@@ -20,7 +20,9 @@ Capability-equivalent of the reference's ``search_by_chunks``
 
 from __future__ import annotations
 
+import json
 import os
+import time
 
 import numpy as np
 
@@ -32,7 +34,8 @@ from ..ops.search import dedispersion_search
 from ..parallel.stream import iter_chunk_starts, plan_chunks
 from ..pipeline.pulse_info import PulseInfo
 from ..pipeline.spectral_stats import get_bad_chans
-from ..utils.logging_utils import StageTimer, logger
+from ..utils.logging_utils import (BudgetAccountant, logger,
+                                   measure_device_rtt)
 
 
 def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
@@ -127,7 +130,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      cut_outliers=False, zero_dm=False, max_chunks=None,
                      progress=True, period_search=False,
                      period_sigma_threshold=8.0, show_plots=False,
-                     mesh=None, exact_floor="auto"):
+                     mesh=None, exact_floor="auto", overlap_persist=True,
+                     budget=None):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -185,8 +189,35 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     single-pulse detection, with the folded profile and H statistics on
     its :class:`~.pulse_info.PulseInfo`.
 
+    ``overlap_persist`` (default on, round 6) moves each chunk's
+    candidate persist + ledger write onto a single-worker executor so
+    the host-side npz compression of chunk ``k`` overlaps the device
+    search of chunk ``k+1``.  The worker is FIFO, ``save_candidate``
+    precedes ``mark_done`` inside one task, and every task is drained
+    before the function returns — ledger ordering, crash-safe resume
+    semantics and the persisted candidate set are identical to the
+    serial loop (pinned by ``tests/test_budget.py``).
+    ``overlap_persist=False`` restores the strictly serial loop.
+
+    ``budget`` accepts a caller-owned
+    :class:`~pulsarutils_tpu.utils.logging_utils.BudgetAccountant`; by
+    default one is created internally.  Either way every chunk's wall
+    clock is attributed to named buckets (read/upload_wait/clean/search
+    with the kernel facade's sub-buckets/trim/persist/...), with the
+    residual reported as ``unattributed`` per chunk and in the run
+    footer, a measured device RTT pricing the dispatch+readback trip
+    counters, and a one-line ``BUDGET_JSON`` record logged for
+    artifact parsers (the round-5 rehearsal's stage table explained ~6%
+    of its wall clock; this layer exists so that can never happen
+    silently again).
+
     Returns ``(hits, store)`` where hits is a list of
-    ``(istart, iend, PulseInfo, ResultTable)``.
+    ``(istart, iend, PulseInfo, ResultTable)``.  NOTE (round 6): when
+    plotting is off, a hit's retained/persisted ``info.allprofs`` is the
+    self-describing pulse **cutout** (``cutout_start``/``cutout_decim``
+    set, device-sliced before readback), not the full chunk waterfall —
+    pulling multi-GB cleaned chunks back over a slow link per hit was
+    the survey rehearsal's single largest unattributed cost.
     """
     # identity checks on purpose: exact_floor=1 must NOT silently pass
     # as True (the floor-forwarding branches use `is True`/`is not
@@ -223,9 +254,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                            "disabled (install the [plot] extra)")
             make_plots = False
 
-    timer = StageTimer()
+    timer = budget if budget is not None else BudgetAccountant()
+    timer.begin_stream()  # reused accountants: retrace baseline per run
 
-    with_timer = timer.stage
+    with_timer = timer.bucket
     with with_timer("badchans"):
         mask_fileorder = get_bad_chans(fname, surelybad=surelybad)
 
@@ -369,6 +401,13 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         import jax.numpy as jnp
 
         mask_dev = jnp.asarray(np.asarray(mask))
+        # donate the raw chunk buffer into the clean program on
+        # accelerators: it is never touched again (the host copy backs
+        # the fallback), so the cleaned output can reuse its HBM — one
+        # fewer live chunk-sized buffer during the double-buffered
+        # stream.  CPU ignores donation with a per-call warning, so the
+        # flag is backend-gated rather than unconditional.
+        donate = ((0,) if jax.default_backend() in ("tpu", "gpu") else ())
         if packed_bits:
             from ..io.lowbit import device_unpack_block
 
@@ -380,9 +419,15 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     raw, packed_bits, nchan_file,
                     band_descending=descending, xp=jnp), m, xp=jnp)
 
-            device_clean = jax.jit(_unpack_clean)
+            device_clean = jax.jit(_unpack_clean, donate_argnums=donate)
         else:
-            device_clean = jax.jit(functools.partial(_clean, xp=jnp))
+            device_clean = jax.jit(functools.partial(_clean, xp=jnp),
+                                   donate_argnums=donate)
+        if timer.rtt_s is None:  # keep a caller-calibrated RTT
+            timer.rtt_s = measure_device_rtt()
+        if timer.rtt_s is not None:
+            logger.info("device round-trip floor: %.4fs per "
+                        "dispatch+readback trip", timer.rtt_s)
 
     # the chunk list is known upfront, so the NEXT chunk's read/decode
     # overlaps the current chunk's device compute (single reader thread —
@@ -396,13 +441,20 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     from concurrent.futures import ThreadPoolExecutor
 
     def read_at(s):
-        if packed_bits:
-            # packed bytes straight off the mmap: decode happens on
-            # device (or in the host fallback below on demand)
-            return reader.read_block_packed(s, min(plan.step,
-                                                   nsamples - s))
-        return reader.read_block(s, min(plan.step, nsamples - s),
-                                 band_ascending=True)
+        t0 = time.perf_counter()
+        try:
+            if packed_bits:
+                # packed bytes straight off the mmap: decode happens on
+                # device (or in the host fallback below on demand)
+                return reader.read_block_packed(s, min(plan.step,
+                                                       nsamples - s))
+            return reader.read_block(s, min(plan.step, nsamples - s),
+                                     band_ascending=True)
+        finally:
+            # reader-thread seconds: overlapped with the previous
+            # chunk's device work, so accounted but not in any chunk's
+            # serial budget
+            timer.add_async("read_decode", time.perf_counter() - t0)
 
     def prefetch_upload(read_future):
         """Start the async device transfer of the NEXT chunk (main thread).
@@ -422,15 +474,45 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         try:
             import jax
 
-            return jax.device_put(read_future.result())
+            buf = jax.device_put(read_future.result())
+            timer.count("prefetch_uploads")
+            return buf
         except Exception:
             return None
+
+    # persist executor (round 6): one FIFO worker absorbs the per-chunk
+    # candidate compression + ledger write so it overlaps the NEXT
+    # chunk's device search.  Single worker + save-before-mark inside
+    # one task = ledger order and crash-resume semantics byte-identical
+    # to the serial loop.
+    persist_pool = (ThreadPoolExecutor(max_workers=1) if overlap_persist
+                    else None)
+    persist_futures = []
+
+    def _persist_and_mark(payload, istart_, iend_):
+        if payload is not None:
+            store.save_candidate(root, istart_, iend_, *payload)
+        store.mark_done(istart_)
+
+    def _persist_async(payload, istart_, iend_):
+        t0 = time.perf_counter()
+        try:
+            _persist_and_mark(payload, istart_, iend_)
+        finally:
+            timer.add_async("persist", time.perf_counter() - t0)
+
+    def _drain_persist(block=False):
+        # serial semantics: a failed save must fail the run — the
+        # overlap only defers the raise to the next drain point
+        while persist_futures and (block or persist_futures[0].done()):
+            persist_futures.pop(0).result()
 
     reader_pool = ThreadPoolExecutor(max_workers=1)
     next_read = reader_pool.submit(read_at, todo[0]) if todo else None
     array_dev = None  # chunk's prefetched device buffer (if any)
     try:
         for ichunk, istart in enumerate(todo):
+          with timer.chunk(istart):
             chunk_size = min(plan.step, nsamples - istart)
             iend = istart + chunk_size
             t0 = istart * sample_time
@@ -439,12 +521,31 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 array = next_read.result()
             next_read = (reader_pool.submit(read_at, todo[ichunk + 1])
                          if ichunk + 1 < len(todo) else None)
+            src = None
+            if device_clean is not None:
+                with with_timer("upload_wait"):
+                    try:
+                        import jax as _jax
+
+                        src = (array_dev if array_dev is not None
+                               else _jax.device_put(array))
+                        # force the async host->device transfer HERE so
+                        # link time has its own bucket: un-forced, the
+                        # wait surfaces inside whatever device op blocks
+                        # next (the round-5 rehearsal's "search" stage
+                        # silently absorbed the next chunk's upload)
+                        np.asarray(src[:1, :1])
+                        timer.count("readbacks")
+                    except Exception as exc:
+                        logger.warning("device upload failed (%r); "
+                                       "cleaning on host from here on",
+                                       exc)
+                        device_clean = None
             with with_timer("clean"):
                 if device_clean is not None:
                     try:
-                        src = (array_dev if array_dev is not None
-                               else jnp.asarray(array))
                         cleaned = device_clean(src, mask_dev)
+                        timer.count("dispatches")
                         # force: dispatch is async, so a device failure
                         # would otherwise surface as a poisoned array
                         # later, past both fallbacks (block_until_ready
@@ -454,6 +555,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         # the host fallback below never touches a
                         # poisoned device array.
                         np.asarray(cleaned[0, :1])
+                        timer.count("readbacks")
                         array = cleaned
                     except Exception as exc:
                         logger.warning("device clean failed (%r); cleaning "
@@ -539,19 +641,40 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                 info.period_sigma)
 
             if is_hit:
-                # retained across the whole run (hits list): convert any
-                # device-resident arrays to host now, or every hit pins
-                # tens of MB of HBM until the search ends
-                info.allprofs = np.asarray(info.allprofs)
                 info.dm = float(best["DM"])
                 info.snr = float(best["snr"])
                 info.width = float(best["rebin"]) * eff_tsamp
-                info.disp_profile = np.asarray(array.mean(0))
-                if plane is not None:
-                    info.dedisp_profile = np.asarray(plane[table.argbest()])
+                with with_timer("hit_products"):
+                    # readback counters only for DEVICE sources: after a
+                    # fallback to the numpy backend these are host
+                    # arrays and counting them would inflate the
+                    # trips x RTT floor the budget exists to make honest
+                    n_rb = (not isinstance(array, np.ndarray)) \
+                        + (plane is not None
+                           and not isinstance(plane, np.ndarray))
+                    info.disp_profile = np.asarray(array.mean(0))
+                    if plane is not None:
+                        info.dedisp_profile = np.asarray(
+                            plane[table.argbest()])
+                    n_rb += not isinstance(info.allprofs, np.ndarray)
+                    if make_plots:
+                        # the diagnostic figure needs the full waterfall:
+                        # convert device arrays to host now (retained in
+                        # the hits list — an un-pulled hit would pin the
+                        # whole chunk's HBM until the search ends)
+                        info.allprofs = np.asarray(info.allprofs)
+                    else:
+                        # round 6: slice the pulse window DEVICE-side and
+                        # read back only the cutout.  The full cleaned
+                        # chunk is ~GBs over a slow link per hit — the
+                        # round-5 rehearsal's single largest unattributed
+                        # wall cost; the persisted record was this
+                        # trimmed cutout all along
+                        info = store.trim_waterfall(info, table)
+                        info.allprofs = np.asarray(info.allprofs)
+                    if n_rb:
+                        timer.count("readbacks", int(n_rb))
                 info.compute_stats()
-                with with_timer("persist"):
-                    store.save_candidate(root, istart, iend, info, table)
                 hits.append((istart, iend, info, table))
                 logger.info("HIT chunk %d-%d: DM=%.2f snr=%.2f width=%gs",
                             istart, iend, info.dm, info.snr, info.width)
@@ -566,7 +689,28 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                              f"{root}_{istart}-{iend}.jpg"),
                         t0=t0, show=show_plots)
 
-            store.mark_done(istart)
+            # candidate persist + ledger write: overlapped with the NEXT
+            # chunk's device work (FIFO worker), or inline when
+            # overlap_persist=False — identical order and bytes either
+            # way.  Submitted AFTER the plot so mark_done cannot precede
+            # the chunk's diagnostic figure: a crash mid-plot leaves the
+            # chunk un-marked and the resumed run re-renders it, exactly
+            # like the serial loop (code-review r6)
+            payload = (info, table) if is_hit else None
+            if persist_pool is not None:
+                persist_futures.append(persist_pool.submit(
+                    _persist_async, payload, istart, iend))
+                # backpressure: each queued payload retains its cutout +
+                # table on the host, so an unbounded backlog on a
+                # hit-dense stream would grow without limit (the serial
+                # loop had natural backpressure); two in flight keeps
+                # the overlap win while bounding retained memory
+                while len(persist_futures) > 2:
+                    with with_timer("persist_backpressure"):
+                        persist_futures.pop(0).result()
+            else:
+                with with_timer("persist"):
+                    _persist_and_mark(payload, istart, iend)
             # second prefetch window: by the end of the iteration the
             # reader has had the whole search/persist to finish decoding
             # chunk k+1, so this attempt usually fires even when the
@@ -577,11 +721,22 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             if progress and nproc % 50 == 0:
                 logger.info("processed %d chunks (through sample %d/%d)",
                             nproc, iend, nsamples)
+          _drain_persist()
     except BaseException:
         reader_pool.shutdown(wait=False, cancel_futures=True)
+        if persist_pool is not None:
+            persist_pool.shutdown(wait=False, cancel_futures=True)
         raise
     reader_pool.shutdown(wait=True)
+    if persist_pool is not None:
+        # the tail of the persist queue is the only persist time left on
+        # the critical path — everything else overlapped chunk k+1
+        with timer.bucket("persist_drain"):
+            persist_pool.shutdown(wait=True)
+            _drain_persist(block=True)
     timer.report()
+    timer.footer()
+    logger.info("BUDGET_JSON %s", json.dumps(timer.to_json()))
     logger.info("done: %d chunks processed, %d hits, %d noise-certified",
                 nproc, len(hits), ncertified)
     if resume:

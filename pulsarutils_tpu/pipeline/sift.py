@@ -61,22 +61,38 @@ def sift_candidates(cands, time_radius, dm_radius=None):
     best.
 
     Greedy single-linkage in descending S/N order: a candidate joins the
-    first kept group within ``time_radius`` seconds AND the group's DM
-    radius; otherwise it seeds a new group.  ``dm_radius=None`` (default)
-    derives the radius from each group's *seed* DM (``0.02 * seed_dm + 1``
-    — trial-grid spacing grows with DM), so one high-DM candidate cannot
-    inflate the merge radius of every low-DM group.  Returns the kept
-    candidates (descending S/N), each annotated with ``n_members`` — the
-    number of raw detections it absorbed.
+    first kept group within the time radius AND the group's DM radius;
+    otherwise it seeds a new group.
+
+    ``time_radius`` is seconds, or the string ``"pair-width"`` (round 6,
+    ADVICE r5): the radius is then evaluated PER PAIR as ``max(0.5 s,
+    4 x the wider of the two candidates' widths)`` — a single wide
+    (rebin=8, coarse-tsamp) candidate no longer inflates the merge
+    radius of every narrow pulse in the run, while a wide pulse still
+    absorbs its own boxcar-quantised duplicates.  Candidates without a
+    ``width`` key contribute 0 (the 0.5 s floor rules).
+
+    ``dm_radius=None`` (default) derives the radius from each group's
+    *seed* DM (``0.02 * seed_dm + 1`` — trial-grid spacing grows with
+    DM), so one high-DM candidate cannot inflate the merge radius of
+    every low-DM group.  Returns the kept candidates (descending S/N),
+    each annotated with ``n_members`` — the number of raw detections it
+    absorbed.
     """
+    pair_width = time_radius == "pair-width"
     order = sorted(range(len(cands)), key=lambda i: -cands[i]["snr"])
     kept = []
     for i in order:
         c = cands[i]
         for k in kept:
+            if pair_width:
+                t_radius = max(0.5, 4.0 * max(c.get("width", 0.0),
+                                              k.get("width", 0.0)))
+            else:
+                t_radius = time_radius
             k_radius = (0.02 * k["dm"] + 1.0 if dm_radius is None
                         else dm_radius)
-            if (abs(c["time"] - k["time"]) <= time_radius
+            if (abs(c["time"] - k["time"]) <= t_radius
                     and abs(c["dm"] - k["dm"]) <= k_radius):
                 k["n_members"] += 1
                 break
@@ -93,7 +109,11 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
     Default radii: when every hit carries an EXACT arrival time (the
     ``peak`` column), duplicates from the 50% chunk overlap land at the
     *same* time up to boxcar rounding, so ``time_radius`` is
-    width-scale — ``max(0.5 s, 4x the widest hit)``.  A chunk-scale
+    width-scale — PER PAIR, ``max(0.5 s, 4x the wider of the two)``
+    (round 6: the previous global ``4x the widest hit in the run`` let
+    one wide rebin=8 candidate inflate the radius for every narrow
+    pulse; per-pair keeps the wide pulse's own duplicates merged without
+    coupling unrelated narrow ones — ADVICE r5).  A chunk-scale
     radius here is actively wrong at survey chunk sizes: two REAL
     pulses minutes apart merged into one candidate (round-5 survey
     rehearsal, 2 GB file — the sift swallowed a DM-394 pulse 555 s
@@ -119,5 +139,5 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
         if any(c["time_approx"] for c in cands):
             time_radius = 1.5 * max(c["span"] for c in cands)
         else:
-            time_radius = max(0.5, 4.0 * max(c["width"] for c in cands))
+            time_radius = "pair-width"
     return sift_candidates(cands, time_radius, dm_radius)

@@ -117,6 +117,46 @@ def test_save_candidate_trims_survey_scale_waterfall(tmp_path):
     assert loaded.nbin == nbin
 
 
+def test_trim_waterfall_wraps_edge_pulse(tmp_path):
+    # ADVICE r5: the roll convention wraps a dispersed tail circularly
+    # past the chunk end; a pulse near the end must keep its wrapped
+    # columns in the persisted cutout, with the wrap recorded in the
+    # metadata (cutout_start near nbin, columns continuing mod nbin)
+    from pulsarutils_tpu.utils.table import ResultTable
+
+    nchan, nbin = 64, 1 << 18
+    wf = np.zeros((nchan, nbin), np.float32)
+    peak = nbin - 50                    # pulse at the chunk edge
+    wf[:, peak] = 5.0
+    wf[:, :200] = 3.0                   # the wrapped tail at the start
+    info = PulseInfo(allprofs=wf, nbin=nbin, nchan=nchan,
+                     start_freq=1200.0, bandwidth=200.0,
+                     pulse_freq=1.0 / (nbin * 1e-3), dm=350.0, snr=20.0)
+    table = ResultTable({"DM": np.array([350.0]),
+                         "snr": np.array([20.0]),
+                         "peak": np.array([peak]),
+                         "rebin": np.array([1])})
+    store = CandidateStore(str(tmp_path), config_fingerprint(x=2))
+    trimmed = store.trim_waterfall(info, table)
+    cut, lo = trimmed.allprofs, trimmed.cutout_start
+    decim = trimmed.cutout_decim or 1
+    assert cut.shape[1] * decim < nbin
+    # absolute column of cutout column j is (lo + j * decim) mod nbin:
+    # both the peak and its wrapped tail must be inside the window
+    cols = (lo + np.arange(cut.shape[1]) * decim) % nbin
+    assert peak in cols or np.any(np.abs(cols - peak) < decim)
+    assert np.any(cols < 200)           # wrapped columns present
+    assert cut.max() >= 5.0 / decim     # the pulse's energy survived
+    # the wrapped part carries the tail's values, not zero padding
+    wrapped = cut[:, cols < 200]
+    assert wrapped.size and wrapped.max() > 0
+    # round-trips through the store
+    base = store.save_candidate("edge", 0, nbin, info, table)
+    loaded, _ = store.load_candidate("edge", 0, nbin)
+    assert loaded.cutout_start == lo
+    assert os.path.getsize(base + ".info.npz") < 2**24
+
+
 def test_resume_ledger_invalidated_by_config_change(tmp_path):
     fp_a = config_fingerprint(dmmin=100, dmmax=200)
     fp_b = config_fingerprint(dmmin=100, dmmax=300)

@@ -163,3 +163,35 @@ def test_wired_into_transform(monkeypatch):
                                    atol=1e-5)
     np.testing.assert_array_equal(np.asarray(got[4]), np.asarray(want[4]))
     np.testing.assert_array_equal(np.asarray(got[5]), np.asarray(want[5]))
+
+
+def test_over_2pow24_series_warns_peak_inexact(monkeypatch):
+    # ADVICE r5: float32 peak accumulation in the kernel is exact only
+    # below 2^24 samples; the XLA scorer warned, the Pallas path
+    # silently accepted any tile-divisible T.  The kernel invocation is
+    # stubbed (a real 8 x 2^25 plane is a 1 GiB allocation) — the
+    # warning must fire in the wrapper BEFORE any kernel work.
+    from pulsarutils_tpu.ops import score_pallas
+
+    t = 1 << 25
+    calls = []
+
+    def fake_kernel(rows_p, t_, t_blk, with_cert, interpret, sub):
+        calls.append((rows_p, t_, t_blk))
+        return jnp.zeros((rows_p, 128), jnp.float32)
+
+    monkeypatch.setattr(score_pallas, "_kernel_scores", fake_kernel)
+    plane = np.broadcast_to(np.float32(0.0), (8, t))  # zero-strided view
+    with pytest.warns(UserWarning, match="2\\^24"):
+        out = score_plane_pallas(plane, with_cert=False)
+    assert calls and calls[0][1] == t  # the stub ran (wrapper reached it)
+    assert out.shape == (5, 8)
+
+    # under the limit: no warning
+    import warnings as _warnings
+
+    small = np.zeros((8, 2048), np.float32)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        score_plane_pallas(jnp.asarray(small), with_cert=False,
+                           interpret=True)

@@ -156,3 +156,33 @@ def test_pucands_lists_and_exports(tmp_path):
     missing = str(tmp_path / "nope")
     assert cands_main([missing]) == 1
     assert not os.path.exists(missing)
+
+
+def test_sift_per_pair_width_radius():
+    # round 6 (ADVICE r5): one wide rebin=8 candidate must not inflate
+    # the merge radius of unrelated narrow pulses.  Two narrow pulses
+    # 2 s apart stay separate (pair radius = 0.5 s floor) even though
+    # the wide candidate's width would have set a 16 s GLOBAL radius —
+    # while the wide pulse still absorbs its own duplicate 3 s away.
+    cands = [
+        {"time": 100.0, "dm": 150.0, "snr": 9.0, "width": 2e-3},
+        {"time": 102.0, "dm": 150.5, "snr": 8.0, "width": 2e-3},
+        {"time": 500.0, "dm": 300.0, "snr": 12.0, "width": 4.0},
+        {"time": 503.0, "dm": 300.5, "snr": 11.0, "width": 4.0},
+    ]
+    kept = sift_candidates(cands, time_radius="pair-width")
+    assert len(kept) == 3
+    times = sorted(round(k["time"], 1) for k in kept)
+    assert times == [100.0, 102.0, 500.0]
+    wide = [k for k in kept if k["time"] == 500.0][0]
+    assert wide["n_members"] == 2
+
+    # the old global radius (4 x widest = 16 s) would have merged the
+    # two narrow pulses into one
+    kept_global = sift_candidates(cands, time_radius=16.0)
+    assert len(kept_global) == 2
+
+    # candidates without widths fall back to the 0.5 s floor
+    bare = [{"time": 0.0, "dm": 10.0, "snr": 5.0},
+            {"time": 0.4, "dm": 10.0, "snr": 4.0}]
+    assert len(sift_candidates(bare, time_radius="pair-width")) == 1

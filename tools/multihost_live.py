@@ -14,14 +14,28 @@ Usage: python tools/multihost_live.py            # parent / orchestrator
 """
 
 import os
+import socket
 import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-PORT = 38921
 NPROC = 2
 GEOM = (1200.0, 200.0, 0.001)
+
+
+def _free_port():
+    """Ephemeral coordinator port, bound-then-released by the
+    orchestrator and passed to ranks via the environment.  A hard-coded
+    port (38921 pre-round-6) collides when two runs share a host —
+    parallel CI jobs degraded into 600 s timeout flakes (ADVICE r5).
+    The bind reserves the number at the OS level; the tiny
+    release-to-reuse window is the standard trade and has not flaked."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def rank_main(rank):
@@ -33,8 +47,9 @@ def rank_main(rank):
 
     from pulsarutils_tpu.parallel import multihost
 
+    port = int(os.environ["PUTPU_MULTIHOST_PORT"])
     multi = multihost.initialize(
-        coordinator_address=f"127.0.0.1:{PORT}", num_processes=NPROC,
+        coordinator_address=f"127.0.0.1:{port}", num_processes=NPROC,
         process_id=rank)
     assert multi, "initialize() reported single-process"
     assert jax.process_count() == NPROC, jax.process_count()
@@ -76,9 +91,11 @@ def main():
         rank_main(int(rank))
         return 0
 
+    port = _free_port()
     procs = []
     for r in range(NPROC):
         env = dict(os.environ, PUTPU_MULTIHOST_RANK=str(r),
+                   PUTPU_MULTIHOST_PORT=str(port),
                    XLA_FLAGS="--xla_force_host_platform_device_count=4")
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)], env=env,

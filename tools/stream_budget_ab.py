@@ -1,0 +1,153 @@
+"""A/B driver for the streaming wall-clock budget (round 6).
+
+Runs a rehearsal-style ``search_by_chunks`` stream — real on-disk 2-bit
+descending-band file, packed upload path, hybrid kernel at the
+certifiable floor, a pulse in every chunk (the rehearsal's stride-2
+worst case for the certificate) — on whatever backend JAX resolves, and
+records wall/chunk plus the per-stage/per-bucket attribution.
+
+Purpose: the committed pre/post measurement for the round-6 budget
+work (VERDICT r5 #1: the round-5 rehearsal's stage table explained ~6%
+of its wall).  The same input file and parameters are searched by the
+"pre" (round-5) and "post" (round-6) code; the JSON this writes is the
+BENCH_*-style artifact.
+
+Usage: python tools/stream_budget_ab.py --out /tmp/stream_pre.json \
+           [--dir /tmp/stream_ab] [--nhops 8] [--nchan 256] [--keep]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TSAMP = 1e-3
+FBOT, FTOP = 1200.0, 1400.0
+DMMIN, DMMAX = 300.0, 400.0
+HOP = 1 << 15                    # step = 2 * HOP = 65536 samples
+CHUNK_LEN_S = HOP * TSAMP
+
+
+def generate(path, nchan, nsamples, log, hop=HOP, margin=2048):
+    """2-bit descending-band file with one exact-track pulse per odd hop
+    (every 50%-overlap chunk contains a pulse — certificate never fires,
+    the rehearsal's worst case).  Shared with ``bench_suite`` config 7
+    (one copy of the track-injection arithmetic, two drivers)."""
+    from pulsarutils_tpu.io.sigproc import FilterbankWriter
+    from pulsarutils_tpu.ops.plan import dedispersion_shifts
+
+    header = {"nchans": nchan, "nbits": 2, "nifs": 1, "tsamp": TSAMP,
+              "fch1": FTOP, "foff": -(FTOP - FBOT) / nchan,
+              "tstart": 60000.0, "source_name": "BUDGET_AB"}
+    rng = np.random.default_rng(7)
+    pulses = []
+    for hopi in range(1, nsamples // hop - 1, 2):
+        pos = hopi * hop + int(rng.integers(margin, hop - margin))
+        dm = float(rng.uniform(DMMIN + 5, DMMAX - 5))
+        pulses.append((pos, dm, 0.8))
+    shifts = {dm: np.rint(np.asarray(dedispersion_shifts(
+        nchan, dm, FBOT, FTOP - FBOT, TSAMP))).astype(np.int64)
+        for _, dm, _ in pulses}
+
+    noise = np.random.default_rng(42)
+    block_n = 1 << 16
+    with FilterbankWriter(path, header) as w:
+        for lo in range(0, nsamples, block_n):
+            n = min(block_n, nsamples - lo)
+            block = noise.normal(1.6, 0.65, (nchan, n)).astype(np.float32)
+            for pos, dm, amp in pulses:
+                tc = pos + shifts[dm]
+                sel = (tc >= lo) & (tc < lo + n)
+                block[np.flatnonzero(sel), tc[sel] - lo] += amp
+            w.write_block(block[::-1])
+    log(f"generated {os.path.getsize(path) / 2**20:.1f} MiB "
+        f"({nsamples} samples, {len(pulses)} pulses)")
+    return pulses
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument("--dir", default="/tmp/stream_ab")
+    p.add_argument("--nhops", type=int, default=8)
+    p.add_argument("--nchan", type=int, default=256)
+    p.add_argument("--keep", action="store_true")
+    p.add_argument("--label", default="run")
+    opts = p.parse_args(argv)
+
+    def log(msg):
+        print(msg, flush=True)
+
+    os.makedirs(opts.dir, exist_ok=True)
+    path = os.path.join(opts.dir, f"budget_ab_{opts.nchan}_{opts.nhops}.fil")
+    nsamples = opts.nhops * HOP
+    if not os.path.exists(path):
+        generate(path, opts.nchan, nsamples, log)
+    else:
+        log("file already staged")
+
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+    from pulsarutils_tpu.utils.logging_utils import logger
+
+    import logging
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger.addHandler(_Capture())
+
+    outdir = os.path.join(opts.dir, f"out_{opts.label}_{int(time.time())}")
+    t0 = time.perf_counter()
+    hits, store = search_by_chunks(
+        path, chunk_length=CHUNK_LEN_S, dmmin=DMMIN, dmmax=DMMAX,
+        backend="jax", kernel="hybrid", snr_threshold="certifiable",
+        output_dir=outdir, make_plots=False, resume=False, progress=False)
+    wall = time.perf_counter() - t0
+    nchunks = opts.nhops - 1
+
+    budget = None
+    for msg in records:
+        if msg.startswith("BUDGET_JSON "):
+            budget = json.loads(msg[len("BUDGET_JSON "):])
+    stages = {}
+    import re
+    for msg in records:
+        m = re.match(r"stage (\S+)\s+([\d.]+)s total,\s+(\d+) calls", msg)
+        if m:
+            stages[m.group(1)] = [float(m.group(2)), int(m.group(3))]
+
+    out = {
+        "label": opts.label,
+        "backend": os.environ.get("JAX_PLATFORMS") or "default",
+        "file": {"nchan": opts.nchan, "nsamples": nsamples, "nbits": 2,
+                 "mb": round(os.path.getsize(path) / 2**20, 1)},
+        "params": {"chunk_length_s": CHUNK_LEN_S, "dmmin": DMMIN,
+                   "dmmax": DMMAX, "kernel": "hybrid",
+                   "snr_threshold": "certifiable"},
+        "wall_s": round(wall, 3),
+        "chunks": nchunks,
+        "wall_per_chunk_s": round(wall / nchunks, 3),
+        "hits": len(hits),
+        "stages": stages,
+        "budget": budget,
+    }
+    with open(opts.out, "w") as f:
+        json.dump(out, f, indent=1)
+    log(f"wall {wall:.1f}s over {nchunks} chunks "
+        f"-> {wall / nchunks:.2f} s/chunk; {len(hits)} hits; "
+        f"report -> {opts.out}")
+    if not opts.keep:
+        import shutil
+        shutil.rmtree(outdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,7 +143,7 @@ def run_cli(path, outdir, max_chunks=None, extra=()):
 
 def parse_report(out):
     stages = {}
-    for m in re.finditer(r"stage (\w+)\s+([\d.]+)s total,\s+(\d+) calls,"
+    for m in re.finditer(r"stage (\S+)\s+([\d.]+)s total,\s+(\d+) calls,"
                          r"\s+([\d.]+)s/call", out):
         stages[m.group(1)] = (float(m.group(2)), int(m.group(3)),
                               float(m.group(4)))
@@ -154,6 +154,18 @@ def parse_report(out):
                  r"t=([\d.]+)s DM=([\d.]+) snr=([\d.]+)", out)]
     return stages, (tuple(int(g) for g in done.groups()) if done
                     else None), cands
+
+
+def parse_budget(out):
+    """The run's ``BUDGET_JSON`` line (round 6): the per-chunk
+    wall-clock budget the old stage table could not provide — buckets,
+    counters, trips x RTT and the explicit ``unattributed`` residual."""
+    import json
+
+    budget = None
+    for m in re.finditer(r"BUDGET_JSON (\{.*\})", out):
+        budget = json.loads(m.group(1))  # last one wins (run 2)
+    return budget
 
 
 def measure_link_ab(path, log):
@@ -253,7 +265,12 @@ def main(argv=None):
     log("run 2/2: resume to completion ...")
     out2, wall2 = run_cli(path, outdir)
     stages, done2, cands = parse_report(out2)
+    budget = parse_budget(out2)
     log(f"  run2: {done2} wall={wall2:.0f}s stages={stages}")
+    if budget:
+        log(f"  budget: {budget['attributed_pct']}% of {budget['wall_s']}s "
+            f"chunk wall attributed ({budget.get('trips', 0)} device "
+            f"trips x {budget.get('rtt_s', 0)}s RTT)")
 
     link = None
     if not opts.skip_link_ab:
@@ -312,6 +329,32 @@ def main(argv=None):
                                            key=lambda kv: -kv[1][0]):
             lines.append(f"| {k} | {tot:.1f} | {calls} | {per:.3f} | "
                          f"{100 * tot / total:.0f}% |")
+        if budget:
+            wall_b = budget["wall_s"] or 1.0
+            lines += [
+                "",
+                "## Per-chunk wall-clock budget (run 2, round-6 "
+                "accountant)",
+                "",
+                f"**{budget['attributed_pct']}% of the "
+                f"{budget['wall_s']:.1f} s summed chunk wall is "
+                f"attributed** (unattributed residual "
+                f"{budget['unattributed_s']:.2f} s); device trips: "
+                f"{budget.get('trips', 0)} x "
+                f"{budget.get('rtt_s', 0):.4f} s RTT = "
+                f"{budget.get('trips_x_rtt_s', 0):.2f} s floor.",
+                "",
+                "| bucket | total s | share of wall |",
+                "|---|---|---|",
+            ]
+            for k, v in budget["buckets_s"].items():
+                lines.append(f"| {k} | {v:.2f} | "
+                             f"{100 * v / wall_b:.1f}% |")
+            lines.append(f"| unattributed | "
+                         f"{budget['unattributed_s']:.2f} | "
+                         f"{100 * budget['unattributed_s'] / wall_b:.1f}% |")
+            lines += ["", f"counters: `{budget['counters']}`;  overlapped "
+                          f"(off critical path): `{budget['async_s']}`"]
         lines += [
             "",
             "## Injected-pulse recovery",
