@@ -13,6 +13,10 @@ all). Failures in one config don't stop the others.
   7  instrumented streaming budget: on-disk 2-bit file -> hybrid
      search_by_chunks with the round-6 BudgetAccountant (wall/chunk,
      buckets, unattributed residual, device trips x RTT)
+  8  mesh fused-vs-unfused hybrid A/B (tools/mesh_fused_ab.py): the
+     MULTICHIP_r06-style record with per-route dispatch/readback
+     counters — one fused shard_map program per hit chunk vs coarse +
+     one dispatch per rescore bucket
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -38,6 +42,19 @@ def emit(obj):
 # geometry/injected-DM single source of truth: bench.py's constants (the
 # simulated dispersion and the suite's searches must share one geometry)
 from bench import GEOM  # noqa: E402
+
+
+def _load_tool(name):
+    """Import a tools/ module by path (the suite configs reuse the
+    committed probe/generator tools rather than forking copies)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def simulate(nchan, nsamp, seed=0):
@@ -387,7 +404,6 @@ def config7(quick):
     measured device RTT — on a tunnelled TPU the trips x RTT line is
     the irreducible-floor evidence VERDICT r5 #1 asked for.
     """
-    import importlib.util
     import tempfile
 
     from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
@@ -395,12 +411,7 @@ def config7(quick):
 
     # one copy of the 2-bit pulse-file generator (exact-track injection,
     # descending band): tools/stream_budget_ab.py owns it
-    spec = importlib.util.spec_from_file_location(
-        "stream_budget_ab",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "tools", "stream_budget_ab.py"))
-    ab = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(ab)
+    ab = _load_tool("stream_budget_ab")
 
     nchan = 256 if not quick else 64
     hop = (1 << 15) if not quick else (1 << 12)
@@ -434,10 +445,35 @@ def config7(quick):
           "trips_x_rtt_s": j.get("trips_x_rtt_s")})
 
 
+def config8(quick):
+    """Mesh fused-vs-unfused hybrid A/B (round 6, ISSUE 2).
+
+    Runs ``tools/mesh_fused_ab.py``'s probe on whatever devices exist —
+    a (1, 1) mesh everywhere (the overhead-floor configuration the
+    round-5 verdict measured at +0.264 s/search unfused on v5e) plus
+    the all-devices mesh when more are available — and emits the
+    MULTICHIP_r06-style record.  The dispatch counters are the
+    platform-independent evidence: the fused route pays ONE program +
+    ONE packed readback per typical hit chunk.
+    """
+    ab = _load_tool("mesh_fused_ab")
+
+    result = ab.ab_cpu(quick=quick, log=log)
+    fused = result["meshes"]["1x1"]["fused"]
+    unfused = result["meshes"]["1x1"]["unfused"]
+    emit({"config": 8, "metric": "mesh (1,1) hybrid fused-vs-unfused "
+          f"A/B, {result['config']}",
+          "value": unfused["trips"] - fused["trips"],
+          "unit": "device round trips saved per hit chunk",
+          "fused_wall_s": fused["wall_s"],
+          "unfused_wall_s": unfused["wall_s"],
+          "ab": result})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
-                        default=[1, 2, 3, 4, 5, 6, 7])
+                        default=[1, 2, 3, 4, 5, 6, 7, 8])
     opts = parser.parse_args(argv)
     quick = os.environ.get("BENCH_PRESET") == "quick"
     try:  # persistent compile cache (big-shape compiles run minutes cold)
@@ -449,7 +485,7 @@ def main(argv=None):
     except Exception:
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7}
+           6: config6, 7: config7, 8: config8}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

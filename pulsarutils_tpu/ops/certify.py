@@ -379,6 +379,37 @@ def retention_bound(nchan, trial_dms, start_freq, bandwidth, sample_time,
                     nsamples).min())
 
 
+def fused_cert_params(nchan, trial_dms, start_freq, bandwidth, sample_time,
+                      nsamples, snr_floor=None, rho_cert=None,
+                      cert_slack=None):
+    """The ``(rho, slack, floor)`` float32 runtime operand of the fused
+    hybrid programs — ONE place constructs it so the single-device
+    (``ops/search.py:_fused_hybrid_seed_kernel``) and mesh
+    (``parallel/sharded_fdmt.py``) fused kernels share the need stage's
+    contract: ``rho = +inf`` disables the device's cert terms (the
+    consistency guards still fire), ``floor = +inf`` disables the floor
+    terms.  ``rho_cert=None`` computes the retention bound — the same
+    lru-cached computation :func:`~..ops.search.hybrid_certificate_gate`
+    performs, under the same ``search/cert_floor`` budget bucket so a
+    cache miss cannot hide inside the fused dispatch.
+    """
+    from ..utils.logging_utils import budget_bucket
+
+    if rho_cert is False:
+        rho_val = np.inf
+    elif rho_cert is not None:
+        rho_val = float(rho_cert)
+    else:
+        with budget_bucket("search/cert_floor"):
+            rho_val = retention_bound(nchan, trial_dms, start_freq,
+                                      bandwidth, sample_time, nsamples,
+                                      cert=True)
+    slack_val = (HYBRID_CERT_SLACK if cert_slack is None
+                 else float(cert_slack))
+    floor_val = np.inf if snr_floor is None else float(snr_floor)
+    return np.asarray([rho_val, slack_val, floor_val], np.float32)
+
+
 def certify_noise_only(cert_scores, snr_floor, rho_cert_min,
                        coarse_snrs=None, slack=None):
     """True iff the coarse sweep certifies no pulse reaches ``snr_floor``

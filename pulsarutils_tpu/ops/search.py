@@ -862,6 +862,93 @@ HYBRID_SEED_BUCKET = 8
 HYBRID_NEED_BUCKET = 8
 
 
+def fused_masked_topk(score, mask, bucket):
+    """Device-side selection of up to ``bucket`` rows of ``mask``.
+
+    Shared by the single-device and mesh fused hybrid kernels:
+    ``top_k`` over ``score`` restricted to ``mask``, with slots beyond
+    the flagged count (``n = mask.sum()``) repeating the top selected
+    row — every returned index names a flagged row (or a duplicate of
+    one, whose exact scores are equally valid), so the host may apply
+    the whole selection unconditionally.  Returns ``(sel, n)`` with
+    ``sel`` int32 of length ``bucket``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ndm = score.shape[0]
+    k = min(bucket, ndm)
+    _, sel = jax.lax.top_k(jnp.where(mask, score, -jnp.inf), k)
+    if bucket > k:
+        sel = jnp.concatenate(
+            [sel, jnp.broadcast_to(sel[:1], (bucket - k,))])
+    n = mask.sum()
+    return jnp.where(jnp.arange(bucket) < n, sel, sel[0]), n
+
+
+def fused_need_stage(coarse, best_exact, rescored, cert_params, bucket2):
+    """The guarantee loop's round-1 need mask, evaluated device-side.
+
+    Mirrors :func:`hybrid_guarantee_loop`'s cert-based criterion exactly
+    — including both consistency guards and the floor terms — against
+    the seed stage's ``best_exact``.  ``coarse`` is the ``(6, ndm)``
+    plan-grid score pack (row 2 the block S/N, row 5 the sliding
+    certificate score); ``cert_params = (rho, slack, floor)`` arrives as
+    a runtime array so one compiled program serves any bound/floor
+    (``+inf`` disables the respective terms — see
+    :func:`~.certify.fused_cert_params`).  Returns ``(sel2, n_need)``:
+    the top-``bucket2`` flagged rows cert-descending (the rows hardest
+    to rule out; overflow slots duplicate the top row) and the total
+    flagged count.  Shared by the single-device and mesh fused kernels
+    so the two programs can never drift from the host loop or from each
+    other.
+    """
+    rho, slack, floor = cert_params[0], cert_params[1], cert_params[2]
+    snr_c, cert = coarse[2], coarse[5]
+    need = cert >= rho * best_exact - slack
+    need |= snr_c >= best_exact          # consistency guard
+    need |= cert >= rho * floor - slack  # floor contract
+    need |= snr_c >= floor               # its consistency guard
+    need &= ~rescored
+    return fused_masked_topk(cert, need, bucket2)
+
+
+def unpack_fused_hybrid(packed, ndm, bucket, bucket2):
+    """Host-side inverse of the fused hybrid kernels' packed layout.
+
+    ``[coarse (6*ndm) | sel (bucket) | exact (5*bucket) | n_seed (1) |
+    sel2 (bucket2) | exact2 (5*bucket2) | n_need (1)]`` — the trailing
+    four parts absent when ``bucket2 == 0`` (indices < 2^24 are exact in
+    float32).  Returns ``(coarse, sel, seed_scores, n_seed, sel2,
+    need_scores, n_need)`` with ``coarse`` float64 ``(6, ndm)``.
+    """
+    coarse = packed[:6 * ndm].reshape(6, ndm).astype(np.float64)
+    pos = 6 * ndm
+    sel = np.rint(packed[pos:pos + bucket]).astype(np.int64)
+    pos += bucket
+    seed_scores = packed[pos:pos + 5 * bucket].reshape(5, bucket)
+    pos += 5 * bucket
+    n_seed = int(np.rint(packed[pos]))
+    pos += 1
+    if not bucket2:
+        return coarse, sel, seed_scores, n_seed, None, None, 0
+    sel2 = np.rint(packed[pos:pos + bucket2]).astype(np.int64)
+    pos += bucket2
+    need_scores = packed[pos:pos + 5 * bucket2].reshape(5, bucket2)
+    n_need = int(np.rint(packed[pos + 5 * bucket2]))
+    return coarse, sel, seed_scores, n_seed, sel2, need_scores, n_need
+
+
+def fused_scores_to_host(scores, roll_k, nsamples):
+    """Float32 ``(5, n)`` score pack -> host column tuple
+    ``(max, std, snr, window, peak)``, the rebase rotation undone on the
+    peak index (shared by the fused hybrids' seed/need-stage unpacks)."""
+    m, s, b, w, p = (scores[i].astype(np.float64) for i in range(5))
+    w = np.rint(w).astype(np.int32)
+    p = (np.rint(p).astype(np.int64) - roll_k) % nsamples
+    return m, s, b, w, p
+
+
 @functools.lru_cache(maxsize=8)
 def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
                               t_tile, n_lo, t_orig, max_off, ndm_plan,
@@ -882,9 +969,10 @@ def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
     the fused need stage a typical hit chunk's guarantee loop finds
     nothing left to rescore and the whole search is ONE round trip
     (VERDICT r3 #4).
-    Packing layout: ``[coarse (6*ndm_plan) | sel (bucket) |
-    exact (5*bucket) | sel2 (bucket2) | exact2 (5*bucket2) |
-    n_need (1)]`` (indices < 2^24 are exact in float32); coarse row 5
+    Packing layout: the shared fused-hybrid pack
+    (:func:`unpack_fused_hybrid`); the ``n_seed`` slot is the constant
+    ``bucket`` here (the top-k seed always fills its slots — the mesh
+    kernel's mask-based seed is the variable-count case).  Coarse row 5
     is the sliding certificate score (:func:`cert_profile_scores`).
 
     The need mask mirrors :func:`hybrid_guarantee_loop`'s cert-based
@@ -924,44 +1012,31 @@ def _fused_hybrid_seed_kernel(nchan, start_freq, bandwidth, n_hi, t_run,
                                                dm_block=bucket)
         exact = score_profiles_stacked(plane, xp=jnp)   # (5, bucket)
         parts = [coarse.reshape(-1), sel.astype(jnp.float32),
-                 exact.reshape(-1)]
+                 exact.reshape(-1),
+                 jnp.full((1,), bucket, jnp.float32)]  # n_seed slot
         if bucket2:
-            rho, slack, floor = (cert_params[0], cert_params[1],
-                                 cert_params[2])
             best_exact = exact[2].max()
-            cert = coarse[5]
-            snr_c = coarse[2]
             rescored = jnp.zeros(ndm_plan, bool).at[sel].set(True)
-            need = cert >= rho * best_exact - slack
-            need |= snr_c >= best_exact          # consistency guard
-            need |= cert >= rho * floor - slack  # floor contract
-            need |= snr_c >= floor               # its consistency guard
-            need &= ~rescored
-            n_need = need.sum()
-            # rescore the strongest flagged rows (cert-descending — the
-            # rows hardest to rule out); slots beyond the flagged count
-            # pick arbitrary rows, whose exact scores are still valid.
-            # The whole stage is SKIPPED (lax.cond) when nothing is
-            # flagged — the common bright-pulse case converges on the
-            # seed alone, and an unconditional 32-row rescore measured
-            # 1069 -> 806 tr/s on the benchmark (the host applies sel2
-            # only when n_need > 0, so the skip branch's zeros are
-            # never consumed).
-            _, sel2 = jax.lax.top_k(
-                jnp.where(need, cert, -jnp.inf), min(bucket2, ndm_plan))
-            sel2 = jnp.concatenate(
-                [sel2, jnp.broadcast_to(
-                    sel2[:1], (bucket2 - min(bucket2, ndm_plan),))])
+            # rescore the strongest flagged rows (fused_need_stage:
+            # cert-descending — the rows hardest to rule out; overflow
+            # slots duplicate the top flagged row).  The whole stage is
+            # SKIPPED (lax.cond) when nothing is flagged — the common
+            # bright-pulse case converges on the seed alone, and an
+            # unconditional 32-row rescore measured 1069 -> 806 tr/s on
+            # the benchmark (the host applies sel2 only when n_need > 0,
+            # so the skip branch's zeros are never consumed).
+            sel2, n_need = fused_need_stage(coarse, best_exact, rescored,
+                                            cert_params, bucket2)
 
-            def rescore2(_):
+            def rescore2(rows):
                 plane2 = dedisperse_plane_pallas_traced(
-                    data, offsets_rebased[sel2], max_off,
+                    data, offsets_rebased[rows], max_off,
                     dm_block=bucket2)
                 return score_profiles_stacked(plane2, xp=jnp)
 
             exact2 = jax.lax.cond(
                 n_need > 0, rescore2,
-                lambda _: jnp.zeros((5, bucket2), jnp.float32), None)
+                lambda _: jnp.zeros((5, bucket2), jnp.float32), sel2)
             parts += [sel2.astype(jnp.float32), exact2.reshape(-1),
                       n_need.astype(jnp.float32)[None]]
         return jnp.concatenate(parts)
@@ -1140,21 +1215,15 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         # same lru-cached computation the gate performs, so no extra
         # cost — rho_cert=False (cert opt-out) sends +inf, which
         # disables the device's cert terms (the consistency guards
-        # still flag displayed-score beats)
-        from .certify import HYBRID_CERT_SLACK as _SLACK
-        from .certify import retention_bound
+        # still flag displayed-score beats).  fused_cert_params is the
+        # one constructor of this operand, shared with the mesh kernel.
+        from .certify import fused_cert_params
 
-        if rho_cert is False:
-            rho_val = np.inf
-        elif rho_cert is not None:
-            rho_val = float(rho_cert)
-        else:
-            with budget_bucket("search/cert_floor"):
-                rho_val = retention_bound(nchan, trial_dms, start_freq,
-                                          bandwidth, sample_time, nsamples,
-                                          cert=True)
-        slack_val = _SLACK if cert_slack is None else float(cert_slack)
-        floor_val = np.inf if snr_floor is None else float(snr_floor)
+        cert_params = fused_cert_params(nchan, trial_dms, start_freq,
+                                        bandwidth, sample_time, nsamples,
+                                        snr_floor=snr_floor,
+                                        rho_cert=rho_cert,
+                                        cert_slack=cert_slack)
 
         # the head flag is resolved HERE so it keys the builder's lru
         # cache (an in-builder env read would serve a stale compiled
@@ -1172,18 +1241,11 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         with budget_bucket("search/fused"):
             packed = np.asarray(kernel(
                 data32, jnp.asarray(idx.astype(np.int32)), offs_dev,
-                jnp.asarray([rho_val, slack_val, floor_val], jnp.float32)))
+                jnp.asarray(cert_params)))
             budget_count("dispatches")
             budget_count("readbacks")
-        coarse = packed[:6 * ndm].reshape(6, ndm).astype(np.float64)
-        sel = np.rint(packed[6 * ndm:6 * ndm + bucket]).astype(np.int64)
-        pos = 6 * ndm + bucket
-        seed_scores = packed[pos:pos + 5 * bucket].reshape(5, bucket)
-        pos += 5 * bucket
-        sel2 = np.rint(packed[pos:pos + bucket2]).astype(np.int64)
-        pos += bucket2
-        need_scores = packed[pos:pos + 5 * bucket2].reshape(5, bucket2)
-        n_need = int(np.rint(packed[pos + 5 * bucket2]))
+        (coarse, sel, seed_scores, _, sel2, need_scores,
+         n_need) = unpack_fused_hybrid(packed, ndm, bucket, bucket2)
         maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]
         windows = np.rint(coarse[3]).astype(np.int32)
         peaks = np.rint(coarse[4]).astype(np.int64)
@@ -1268,11 +1330,7 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         if n_need > 0:
             blocks.append((sel2, need_scores))
         for rows, scores in blocks:
-            m, s, b_, w, p = (scores[i].astype(np.float64)
-                              for i in range(5))
-            w = np.rint(w).astype(np.int32)
-            p = (np.rint(p).astype(np.int64) - roll_k) % nsamples
-            _apply(rows, (m, s, b_, w, p))
+            _apply(rows, fused_scores_to_host(scores, roll_k, nsamples))
     # the cert-based criterion covers the snr_floor rows directly
     # (every row that could hold an above-floor detection is flagged
     # per-row), so no separate floor pre-pass is needed

@@ -22,6 +22,25 @@ from __future__ import annotations
 import numpy as np
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across JAX versions — ONE call site owns the API
+    drift so every mesh kernel builder stays version-agnostic:
+
+    * new API (``jax.shard_map``, ``check_vma=``) when present;
+    * else the long-stable ``jax.experimental.shard_map.shard_map``
+      (``check_rep=`` — the same lint under its older name).
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma)
+
+
 def make_mesh(shape=None, axis_names=("dm", "chan"), devices=None):
     """Build a ``Mesh`` over the available devices.
 

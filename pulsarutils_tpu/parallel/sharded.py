@@ -34,6 +34,7 @@ from ..ops.search import (
     score_profiles_stacked,
     unstack_scores,
 )
+from ..utils.logging_utils import budget_bucket, budget_count
 from ..utils.table import ResultTable
 from .mesh import pad_to_multiple
 
@@ -72,7 +73,9 @@ def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
     out_specs = ((out_scores, P("dm", None)) if capture_plane
                  else out_scores)
 
-    fn = jax.shard_map(
+    from .mesh import shard_map_compat
+
+    fn = shard_map_compat(
         local_search,
         mesh=mesh,
         in_specs=(P("chan", None), P("dm", "chan"), P()),
@@ -89,7 +92,8 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
                                 sample_time, mesh, *, trial_dms=None,
                                 capture_plane=False, chan_block=None,
                                 dtype=None, kernel="auto",
-                                plane_handle=False):
+                                plane_handle=False, offsets=None,
+                                pallas_max_off=None):
     """Run the full DM sweep sharded over ``mesh`` axes ``("dm", "chan")``.
 
     Same result contract as
@@ -105,6 +109,17 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     DM-sharded and device-resident, returned as a
     :class:`~.sharded_plane.ShardedPlane` instead of a host gather (the
     mesh streaming diagnostics path).
+
+    ``offsets`` (with an explicit ``trial_dms``) supplies the precomputed
+    int32 gather-offset rows for those trials, so a caller cycling many
+    small trial subsets over one chunk geometry (the sharded hybrid's
+    rescore buckets) slices ONE cached table instead of re-deriving the
+    plan shifts host-side per call.  ``pallas_max_off`` pins the Pallas
+    kernel's static halo bound to a caller-chosen value covering every
+    subset (e.g. the full table's rebased bound, power-of-two rounded):
+    without it each subset's own bound keys the compiled-program cache,
+    and a subset spanning a different offset range silently retraces —
+    the retrace detector (``BudgetAccountant``) flags exactly that.
     """
     import jax
     import jax.numpy as jnp
@@ -117,8 +132,18 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     trial_dms = np.asarray(trial_dms, dtype=np.float64)
     ndm = len(trial_dms)
 
-    offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
-                           sample_time, nsamples)
+    if offsets is None:
+        # per-call host plan math — hoist it with offsets= when calling
+        # repeatedly at one geometry (the counter makes a hot-loop
+        # rebuild visible in the chunk budget)
+        budget_count("offset_tables")
+        offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
+                               sample_time, nsamples)
+    else:
+        offsets = np.asarray(offsets, dtype=np.int32)
+        if offsets.shape != (ndm, nchan):
+            raise ValueError(f"offsets shape {offsets.shape} does not "
+                             f"match ({ndm}, {nchan})")
 
     dm_size = mesh.shape["dm"]
     chan_size = mesh.shape["chan"]
@@ -152,15 +177,25 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
         from ..ops.pallas_dedisperse import rebase_offsets
 
         offsets, roll_k, max_off = rebase_offsets(offsets, nsamples)
-        if max_off > 0:
-            max_off = 1 << int(np.ceil(np.log2(max_off + 1)))
-        max_off = max(max_off, 256)
+        if pallas_max_off is not None:
+            # caller-pinned static halo bound: one compiled program per
+            # bucket shape across every trial subset (no silent retrace)
+            if pallas_max_off < max_off:
+                raise ValueError(f"pallas_max_off={pallas_max_off} does "
+                                 f"not cover the subset bound {max_off}")
+            max_off = int(pallas_max_off)
+        else:
+            if max_off > 0:
+                max_off = 1 << int(np.ceil(np.log2(max_off + 1)))
+            max_off = max(max_off, 256)
     else:
         max_off = 0
     compiled = _sharded_kernel(mesh, capture_plane, chan_block, kernel,
                                max_off)
-    out = compiled(jnp.asarray(data_padded, dtype=dtype),
-                   jnp.asarray(offsets), jnp.int32(roll_k))
+    with budget_bucket("search/dispatch"):
+        out = compiled(jnp.asarray(data_padded, dtype=dtype),
+                       jnp.asarray(offsets), jnp.int32(roll_k))
+        budget_count("dispatches")
 
     from .mesh import fetch_global as fetch
 
@@ -171,11 +206,16 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
 
             plane = ShardedPlane(plane, mesh, "dm", np.arange(ndm))
         else:
-            plane = fetch(plane)[:ndm]
+            with budget_bucket("search/readback"):
+                plane = fetch(plane)[:ndm]
+                budget_count("readbacks")
     else:
         stacked, plane = out, None
+    with budget_bucket("search/readback"):
+        stacked_host = fetch(stacked)[:, :ndm]
+        budget_count("readbacks")
     maxvalues, stds, best_snrs, best_windows, best_peaks = unstack_scores(
-        fetch(stacked)[:, :ndm])
+        stacked_host)
 
     table = ResultTable({
         "DM": trial_dms,

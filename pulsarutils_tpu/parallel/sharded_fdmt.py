@@ -40,8 +40,9 @@ from ..ops.fdmt import (
     fdmt_plan,
     fdmt_trial_dms,
 )
+from ..utils.logging_utils import budget_bucket, budget_count
 from ..utils.table import ResultTable
-from .mesh import fetch_global
+from .mesh import fetch_global, pad_to_multiple
 
 __all__ = ["sharded_fdmt_search", "sharded_hybrid_search",
            "slice_delay_range"]
@@ -154,9 +155,11 @@ def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
                                         with_cert=with_cert)[None]
         return (scores, state) if with_plane else scores
 
+    from .mesh import shard_map_compat
+
     in_specs = [P()] + [P(axis)] * (4 * len(iter_meta))
     out_specs = (P(axis), P(axis, None)) if with_plane else P(axis)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map_compat(
         local_fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
         # pallas_call outputs carry no varying-mesh-axes metadata, which
         # trips shard_map's vma lint; there are no collectives at all in
@@ -231,8 +234,12 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     if capture_plane:
         from .sharded_plane import ShardedPlane
 
-        out, plane = fn(data, *flat)
-        out = fetch_global(out)
+        with budget_bucket("search/coarse"):
+            out, plane = fn(data, *flat)
+            budget_count("dispatches")
+        with budget_bucket("search/coarse_readback"):
+            out = fetch_global(out)
+            budget_count("readbacks")
         # device d's padded shard starts at d * rows_max in the global
         # concatenated plane; its first (hi-lo+1) rows are its slice
         rows_max = plane.shape[0] // n_dev
@@ -241,7 +248,12 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
              for d, (lo, hi) in enumerate(slices)])
         plane_handle = ShardedPlane(plane, mesh, axis, row_index)
     else:
-        out = fetch_global(fn(data, *flat))
+        with budget_bucket("search/coarse"):
+            out_dev = fn(data, *flat)
+            budget_count("dispatches")
+        with budget_bucket("search/coarse_readback"):
+            out = fetch_global(out_dev)
+            budget_count("readbacks")
 
     # stitch the dm-sharded scores: device d's first (hi-lo+1) rows are
     # its delay slice; the rest is padding junk
@@ -265,10 +277,192 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     return (table, plane_handle) if capture_plane else table
 
 
+@functools.lru_cache(maxsize=8)
+def _plan_offsets(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time,
+                  nsamples):
+    """Chunk-geometry plan grid + full int32 offset table, cached.
+
+    The sharded hybrid used to re-enter ``dedispersion_plan`` +
+    ``_offsets_for`` host-side on EVERY rescore bucket (and on every
+    streaming chunk of identical geometry); one cached table is sliced
+    per bucket instead.  Returned arrays are shared cache objects —
+    callers slice, never mutate.
+    """
+    from ..ops.plan import dedispersion_plan
+    from ..ops.search import _offsets_for
+
+    trial_dms = np.asarray(
+        dedispersion_plan(nchan, dmmin, dmmax, start_freq, bandwidth,
+                          sample_time), dtype=np.float64)
+    offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
+                           sample_time, nsamples)
+    trial_dms.setflags(write=False)  # shared cache objects: fail loudly
+    offsets.setflags(write=False)    # on accidental mutation
+    return trial_dms, offsets
+
+
+@functools.lru_cache(maxsize=8)
+def _build_fused_sharded_hybrid(mesh, nchan, nchan_padded, t, t_tile,
+                                use_pallas, interpret, plan_key, ndm_plan,
+                                bucket, bucket2, rescore_kernel, chan_block,
+                                max_off, nchan_rs):
+    """ONE ``shard_map`` program for the mesh hybrid's first round:
+
+    DM-sliced coarse FDMT (each dm shard runs its delay-range-pruned
+    transform, replicated over ``chan``) -> one small all-gather of the
+    per-shard score packs so every device holds the global plan-grid
+    coarse table -> the guarantee loop's OWN seed rule evaluated
+    device-side (plausible-best + floor rows, grown +/-1 neighbours,
+    selected via :func:`~..ops.search.fused_masked_topk`) -> exact
+    rescore of the seed bucket sharded over the full ``(dm, chan)`` mesh
+    (same per-shard kernel, channel split and psum order as
+    :func:`~.sharded.sharded_dedispersion_search`, so the scores are
+    bit-identical to the unfused escape hatch) -> the need stage
+    (:func:`~..ops.search.fused_need_stage`, shared with the
+    single-device fused kernel) rescored the same way -> everything
+    packed into one replicated float32 vector
+    (:func:`~..ops.search.unpack_fused_hybrid` layout).
+
+    A typical hit chunk's guarantee loop therefore completes in ONE
+    dispatch instead of one coarse ``shard_map`` program plus one per
+    rescore bucket.  The seed rule deliberately differs from the
+    single-device kernel's blind top-k: computing the loop's own mask
+    makes the fused path's rescored set — and hence the ``exact``
+    column — provably identical to the unfused path whenever the mask
+    fits the bucket (the host tops up or falls back otherwise, see
+    ``sharded_hybrid_search``), up to one caveat: the device evaluates
+    the masks in float32 where the host loop uses float64, so a row
+    within one float32 ulp of a criterion threshold can be flagged by
+    one and not the other — a measure-zero tie whose members are
+    score-equivalent either way (the exact-argbest contract is
+    unaffected; the parity tests use decisive data).
+
+    ``check_vma`` is off: the collective structure is three explicit
+    collectives (coarse all-gather, rescore psum + all-gather) and the
+    outputs are replicated by construction, which the vma lint cannot
+    express across the pallas/cond paths.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.dedisperse import dedisperse_block_chunked_jax
+    from ..ops.fdmt import _merge_xla, merge_rows_traced
+    from ..ops.search import (
+        fused_masked_topk,
+        fused_need_stage,
+        score_profiles_chunked,
+        score_profiles_stacked,
+    )
+
+    iter_meta = plan_key  # tuple of (k_tiles, k_tiles_h, rows_max)
+    dm_size = mesh.shape["dm"]
+    chan_size = mesh.shape["chan"]
+    c_loc = nchan_rs // chan_size
+
+    def local_fn(data, idx_map, offsets_rs, cert_params, roll_k, *tables):
+        # ---- coarse: this dm shard's delay-sliced transform (chan
+        # replicated) — identical math to _build_sharded_fdmt.local_fn
+        state = data
+        if nchan < nchan_padded:
+            state = jnp.concatenate(
+                [state, jnp.zeros((nchan_padded - nchan, t), state.dtype)])
+        for i, (k_tiles, k_tiles_h, rows_max) in enumerate(iter_meta):
+            il, ih, sh, shh = (tables[4 * i + j][0] for j in range(4))
+            if use_pallas:
+                state = merge_rows_traced(
+                    state, il, ih, sh,
+                    shh if k_tiles_h else jnp.zeros_like(sh),
+                    k_tiles=k_tiles, k_tiles_h=k_tiles_h, t_tile=t_tile,
+                    interpret=interpret)
+            else:
+                state = _merge_xla(state, il, ih, sh,
+                                   shh if k_tiles_h else None)
+        stacked = score_profiles_chunked(state, jnp, with_cert=True)
+        # ---- ONE small all-gather (6 x D*rows floats): every device
+        # sees the global coarse table, mapped onto the plan grid
+        gathered = jax.lax.all_gather(stacked, "dm")       # (D, 6, R)
+        coarse = gathered.transpose(1, 0, 2).reshape(
+            6, -1)[:, idx_map]                             # (6, ndm_plan)
+        snr_c = coarse[2]
+        floor = cert_params[2]
+        # ---- the guarantee loop's seed rule (hybrid_guarantee_loop),
+        # device-side: plausible-best + floor rows, grown +/-1 grid
+        # neighbours (clipped, not wrapped — matching np.clip there)
+        seed = snr_c >= snr_c.max() - 0.5
+        seed |= snr_c >= floor - 0.75
+        z = jnp.zeros((1,), bool)
+        grown = (seed | jnp.concatenate([seed[1:], z])
+                 | jnp.concatenate([z, seed[:-1]]))
+        sel, n_seed = fused_masked_topk(snr_c, grown, bucket)
+
+        # ---- exact rescore, sharded over the full (dm, chan) mesh with
+        # the unfused path's layout: device (i, j) dedisperses its row
+        # slice over its channel slice, one psum over chan reduces
+        i_dm = jax.lax.axis_index("dm")
+        i_ch = jax.lax.axis_index("chan")
+        if nchan_rs > nchan:
+            data_rs = jnp.concatenate(
+                [data, jnp.zeros((nchan_rs - nchan, t), data.dtype)])
+        else:
+            data_rs = data
+        data_loc = jax.lax.dynamic_slice(data_rs, (i_ch * c_loc, 0),
+                                         (c_loc, t))
+
+        def rescore_rows(rows):
+            nrows = rows.shape[0]
+            rps = nrows // dm_size
+            offs = offsets_rs[rows]
+            offs_loc = jax.lax.dynamic_slice(
+                offs, (i_dm * rps, i_ch * c_loc), (rps, c_loc))
+            if rescore_kernel == "pallas":
+                from ..ops.pallas_dedisperse import (
+                    dedisperse_plane_pallas_traced,
+                )
+
+                partial = dedisperse_plane_pallas_traced(data_loc, offs_loc,
+                                                         max_off)
+            else:
+                partial = dedisperse_block_chunked_jax(data_loc, offs_loc,
+                                                       chan_block)
+            dedisp = jax.lax.psum(partial, "chan")
+            if rescore_kernel == "pallas":
+                dedisp = jnp.roll(dedisp, -roll_k, axis=1)
+            scores = score_profiles_stacked(dedisp, xp=jnp)  # (5, rps)
+            g = jax.lax.all_gather(scores, "dm")             # (D, 5, rps)
+            return g.transpose(1, 0, 2).reshape(5, nrows)
+
+        exact = rescore_rows(sel)
+        parts = [coarse.reshape(-1), sel.astype(jnp.float32),
+                 exact.reshape(-1), n_seed.astype(jnp.float32)[None]]
+        if bucket2:
+            best_exact = exact[2].max()
+            rescored = jnp.zeros(ndm_plan, bool).at[sel].set(True)
+            sel2, n_need = fused_need_stage(coarse, best_exact, rescored,
+                                            cert_params, bucket2)
+            # skipped (lax.cond) when nothing is flagged, exactly like
+            # the single-device kernel — the predicate is replicated, so
+            # every device takes the same branch and the branch's
+            # collectives stay matched
+            exact2 = jax.lax.cond(
+                n_need > 0, rescore_rows,
+                lambda _: jnp.zeros((5, bucket2), jnp.float32), sel2)
+            parts += [sel2.astype(jnp.float32), exact2.reshape(-1),
+                      n_need.astype(jnp.float32)[None]]
+        return jnp.concatenate(parts)
+
+    from .mesh import shard_map_compat
+
+    in_specs = [P(), P(), P(), P(), P()] + [P("dm")] * (4 * len(iter_meta))
+    fn = shard_map_compat(local_fn, mesh=mesh, in_specs=tuple(in_specs),
+                          out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
 def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
                           sample_time, mesh, snr_floor=None,
                           noise_certificate=True, capture_plane=False,
-                          rho_cert=None, cert_slack=None):
+                          rho_cert=None, cert_slack=None, fused=None):
     """Hybrid (exact hits at coarse cost) over a ``(dm, chan)`` mesh.
 
     Multi-device composition of ``dedispersion_search(kernel="hybrid")``:
@@ -296,66 +490,243 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     knobs: a precomputed retention bound (or ``False`` to opt out of
     the cert machinery) and a certificate slack derived from a target
     miss probability (:func:`~pulsarutils_tpu.ops.certify.cert_slack_for_miss_p`).
+
+    ``fused`` (round 6): ``None`` (default) runs the first round —
+    coarse FDMT + seed selection + exact seed/need rescore — as ONE
+    ``shard_map`` dispatch (:func:`_build_fused_sharded_hybrid`)
+    whenever eligible: no plane capture, no certificate-mode floor
+    (mirroring the single-device gating — a noise-certified chunk
+    should pay one coarse dispatch, not a burned seed rescore), cert
+    machinery not opted out, and a trial grid at least one seed bucket
+    wide.  The :func:`~..ops.search.hybrid_certificate_gate` loop stays
+    as the escape hatch: only rows the fused program did not rescore
+    trigger (now rare) follow-up
+    :func:`~.sharded.sharded_dedispersion_search` dispatches, and when
+    the device's seed or need stage overflows its bucket the host
+    discards that stage and completes the round itself, so the rescored
+    set — argbest, ``exact`` column and certificate metadata — is
+    identical to ``fused=False`` (up to float32-vs-float64 threshold
+    ties on the mask criteria — measure-zero, score-equivalent rows;
+    see :func:`_build_fused_sharded_hybrid`).  ``fused=False`` forces
+    the unfused multi-dispatch composition (the A/B baseline);
+    ``fused=True`` raises if the fused program is not eligible.
     """
+    import jax
     import jax.numpy as jnp
 
-    from ..ops.certify import cert_meta
-    from ..ops.plan import dedispersion_plan
+    from ..ops.certify import cert_meta, fused_cert_params
     from ..ops.search import (
+        HYBRID_NEED_BUCKET,
+        HYBRID_SEED_BUCKET,
+        auto_chan_block,
+        fused_scores_to_host,
         hybrid_certificate_gate,
         iter_rescore_buckets,
         nearest_rows,
+        unpack_fused_hybrid,
     )
     from .sharded import sharded_dedispersion_search
 
     nchan, nsamples = np.shape(data)
+    dm_size = mesh.shape["dm"]
+    chan_size = mesh.shape["chan"]
     # (the pad-free soundness guard lives in hybrid_certificate_gate,
     # shared verbatim with the single-device hybrid)
     # ONE host->device transfer: the coarse stage and every rescore call
     # reuse the same device-resident array (sharded_dedispersion_search
     # passes aligned device inputs through untouched)
     data = jnp.asarray(data, jnp.float32)
-    coarse_out = sharded_fdmt_search(data, dmmin, dmmax, start_freq,
-                                     bandwidth, sample_time, mesh,
-                                     axis="dm", with_cert=True,
-                                     capture_plane=capture_plane)
-    t_coarse, plane = coarse_out if capture_plane else (coarse_out, None)
-    trial_dms = np.asarray(dedispersion_plan(
-        nchan, dmmin, dmmax, start_freq, bandwidth, sample_time),
-        dtype=np.float64)
-    ndm = len(trial_dms)
-    idx = nearest_rows(np.asarray(t_coarse["DM"]), trial_dms)
-    if plane is not None:
-        plane = plane.remap(idx)  # coarse rows -> plan grid, still sharded
 
-    maxvalues = np.asarray(t_coarse["max"], np.float64)[idx]
-    stds = np.asarray(t_coarse["std"], np.float64)[idx]
-    snrs = np.asarray(t_coarse["snr"], np.float64)[idx]
-    windows = np.asarray(t_coarse["rebin"], np.int32)[idx]
-    peaks = np.asarray(t_coarse["peak"], np.int64)[idx]
-    cert_scores = np.asarray(t_coarse["cert"], np.float64)[idx]
+    # chunk-geometry plan + offsets: ONE cached host computation, sliced
+    # per rescore bucket (was re-derived inside every bucket call)
+    trial_dms, offsets_full = _plan_offsets(
+        nchan, float(dmmin), float(dmmax), float(start_freq),
+        float(bandwidth), float(sample_time), int(nsamples))
+    ndm = len(trial_dms)
+
+    use_pallas = jax.default_backend() == "tpu"
+    rescore_kernel = ("pallas" if all(d.platform == "tpu"
+                                      for d in mesh.devices.flat)
+                      else "gather")
+    # rescore offsets aligned to the chan axis once (zero channels are
+    # exact no-ops); the escape hatch gets slices of the same raw table
+    # and a matching pre-padded device array, so repeat buckets never
+    # bounce the chunk through the host again
+    offsets_raw, _ = pad_to_multiple(offsets_full, 1, chan_size,
+                                     mode="constant")
+    nchan_rs = offsets_raw.shape[1]
+    if nchan_rs > nchan:
+        # device-side pad: a np.pad here would bounce the (possibly
+        # multi-GB, device-resident) chunk through the host on every
+        # search (code-review r7)
+        data_rs = jnp.pad(data, ((0, nchan_rs - nchan), (0, 0)))
+    else:
+        data_rs = data
+    roll_k = 0
+    rescore_max_off = None
+    offsets_rs = offsets_raw  # the fused kernel's operand
+    if rescore_kernel == "pallas":
+        # ONE rebase bound over the full table, power-of-two rounded:
+        # every bucket subset shares the compiled programs' static halo
+        # (no per-subset cache keys, no silent retrace)
+        from ..ops.pallas_dedisperse import rebase_offsets
+
+        offsets_rs, roll_k, rescore_max_off = rebase_offsets(offsets_raw,
+                                                             nsamples)
+        if rescore_max_off > 0:
+            rescore_max_off = 1 << int(
+                np.ceil(np.log2(rescore_max_off + 1)))
+        rescore_max_off = max(rescore_max_off, 256)
+
+    def _round_up(x, m):
+        return -(-x // m) * m
+
+    bucket = _round_up(HYBRID_SEED_BUCKET, dm_size)
+    bucket2 = _round_up(min(HYBRID_NEED_BUCKET, ndm), dm_size)
+    fused_why = None
+    if capture_plane:
+        fused_why = "capture_plane needs the two-stage coarse program"
+    elif snr_floor is not None and noise_certificate:
+        fused_why = ("certificate mode: a certified chunk should pay one "
+                     "coarse dispatch, not a burned seed rescore")
+    elif rho_cert is False:
+        fused_why = ("rho_cert=False drops the loop to legacy margins, "
+                     "whose adaptive term the device cannot evaluate")
+    elif ndm < max(bucket, bucket2):
+        fused_why = f"trial grid ({ndm}) narrower than the seed bucket"
+    elif use_pallas and _pick_fdmt_tile(nsamples) == 0:
+        fused_why = "padded TPU time axis (rescore wrap convention)"
+    if fused is True and fused_why is not None:
+        raise ValueError(f"fused=True not eligible: {fused_why}")
+    use_fused = fused is not False and fused_why is None
+
+    plane = None
+    n_seed = n_need = 0
+    seed_done = False
+    if use_fused:
+        # ---- ONE dispatch: coarse + seed + need-stage rescore ----------
+        interpret = jax.default_backend() != "tpu"
+        fdmt_dms, n_lo, n_hi = fdmt_trial_dms(nchan, dmmin, dmmax,
+                                              start_freq, bandwidth,
+                                              sample_time)
+        idx = nearest_rows(fdmt_dms, trial_dms)
+        slices = slice_delay_range(n_lo, n_hi, dm_size)
+        t_tile = _pick_fdmt_tile(nsamples)
+        if not use_pallas and t_tile == 0:
+            t_tile = 1024  # unused by the XLA merge path
+        plans = [fdmt_plan(nchan, float(start_freq), float(bandwidth), hi,
+                           lo) for lo, hi in slices]
+        tables = _stacked_tables(plans, t_tile)
+        plan_key = tuple((it["k_tiles"], it["k_tiles_h"], it["rows_max"])
+                         for it in tables)
+        # plan row -> padded position in the all-gathered coarse pack:
+        # device d's shard starts at d * rows_max and its row j holds
+        # delay lo_d + j (the same stitching rule sharded_fdmt_search
+        # applies host-side)
+        rows_max = plan_key[-1][2]
+        his = np.array([hi for _, hi in slices])
+        los = np.array([lo for lo, _ in slices])
+        delay = idx + n_lo
+        dev = np.searchsorted(his, delay)
+        idx_map = (dev * rows_max + (delay - los[dev])).astype(np.int32)
+
+        chan_block = auto_chan_block(nchan_rs // chan_size, nsamples,
+                                     bucket // dm_size)
+        cert_params = fused_cert_params(
+            nchan, trial_dms, start_freq, bandwidth, sample_time, nsamples,
+            snr_floor=snr_floor, rho_cert=rho_cert, cert_slack=cert_slack)
+        kernel_fn = _build_fused_sharded_hybrid(
+            mesh, nchan, plans[0].nchan_padded, nsamples, t_tile,
+            use_pallas, interpret, plan_key, ndm, bucket, bucket2,
+            rescore_kernel, chan_block,
+            0 if rescore_max_off is None else rescore_max_off, nchan_rs)
+        flat = []
+        for it in tables:
+            flat += [jnp.asarray(it[k]) for k in
+                     ("idx_low", "idx_high", "shift", "shift_high")]
+        with budget_bucket("search/fused"):
+            packed = np.asarray(kernel_fn(
+                data, jnp.asarray(idx_map), jnp.asarray(offsets_rs),
+                jnp.asarray(cert_params), jnp.int32(roll_k), *flat))
+            budget_count("dispatches")
+            budget_count("readbacks")
+        (coarse, sel, seed_scores, n_seed, sel2, need_scores,
+         n_need) = unpack_fused_hybrid(packed, ndm, bucket, bucket2)
+        maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]
+        windows = np.rint(coarse[3]).astype(np.int32)
+        peaks = np.rint(coarse[4]).astype(np.int64)
+        cert_scores = coarse[5]
+    else:
+        # ---- two-stage composition (plane capture / certificate mode /
+        # forced A/B baseline): coarse program, scores mapped host-side
+        coarse_out = sharded_fdmt_search(data, dmmin, dmmax, start_freq,
+                                         bandwidth, sample_time, mesh,
+                                         axis="dm", with_cert=True,
+                                         capture_plane=capture_plane)
+        t_coarse, plane = (coarse_out if capture_plane
+                           else (coarse_out, None))
+        idx = nearest_rows(np.asarray(t_coarse["DM"]), trial_dms)
+        if plane is not None:
+            plane = plane.remap(idx)  # coarse rows -> plan grid, sharded
+
+        maxvalues = np.asarray(t_coarse["max"], np.float64)[idx]
+        stds = np.asarray(t_coarse["std"], np.float64)[idx]
+        snrs = np.asarray(t_coarse["snr"], np.float64)[idx]
+        windows = np.asarray(t_coarse["rebin"], np.int32)[idx]
+        peaks = np.asarray(t_coarse["peak"], np.int64)[idx]
+        cert_scores = np.asarray(t_coarse["cert"], np.float64)[idx]
+
     coarse_snrs = snrs.copy()
     exact = np.zeros(ndm, dtype=bool)
 
+    def _apply(blk, scored):
+        m, s, b, w, p = scored
+        k = len(blk)
+        maxvalues[blk] = m[:k]
+        stds[blk] = s[:k]
+        snrs[blk] = b[:k]
+        windows[blk] = w[:k]
+        peaks[blk] = p[:k]
+        exact[blk] = True
+
     def rescore(rows):
+        """Escape hatch: exact scores via the sharded direct sweep —
+        slices of the one cached offset table, pinned Pallas halo, and
+        the pre-aligned device chunk (no per-bucket host work beyond
+        the slice)."""
+        budget_count("rescore_calls")
+        budget_count("rescore_rows", len(rows))
         for blk, padded in iter_rescore_buckets(rows):
             t_ex = sharded_dedispersion_search(
-                data, dmmin, dmmax, start_freq, bandwidth, sample_time,
-                mesh=mesh, trial_dms=trial_dms[padded])
+                data_rs, dmmin, dmmax, start_freq, bandwidth, sample_time,
+                mesh=mesh, trial_dms=trial_dms[padded],
+                offsets=offsets_raw[padded],
+                pallas_max_off=rescore_max_off)
             k = len(blk)
-            maxvalues[blk] = np.asarray(t_ex["max"])[:k]
-            stds[blk] = np.asarray(t_ex["std"])[:k]
-            snrs[blk] = np.asarray(t_ex["snr"])[:k]
-            windows[blk] = np.asarray(t_ex["rebin"])[:k]
-            peaks[blk] = np.asarray(t_ex["peak"])[:k]
-            exact[blk] = True
+            _apply(blk, (np.asarray(t_ex["max"]), np.asarray(t_ex["std"]),
+                         np.asarray(t_ex["snr"]),
+                         np.asarray(t_ex["rebin"]),
+                         np.asarray(t_ex["peak"])))
+
+    if use_fused and n_seed <= bucket:
+        # the device covered the loop's ENTIRE seed round; its scores are
+        # the escape hatch's bit for bit (same per-shard kernel, channel
+        # split and psum order), so the loop continues from the same
+        # state the unfused path would reach.  A need stage that fit its
+        # bucket likewise completes round 1; an overflowed stage is
+        # discarded — the loop recomputes the full round itself.
+        _apply(sel, fused_scores_to_host(seed_scores, roll_k, nsamples))
+        seed_done = True
+        if 0 < n_need <= bucket2:
+            _apply(sel2, fused_scores_to_host(need_scores, roll_k,
+                                              nsamples))
 
     certified, rho_cert_min = hybrid_certificate_gate(
         cert_scores, coarse_snrs, snrs, exact, rescore, nchan=nchan,
         trial_dms=trial_dms, start_freq=start_freq, bandwidth=bandwidth,
         sample_time=sample_time, nsamples=nsamples, snr_floor=snr_floor,
-        noise_certificate=noise_certificate, rho_cert=rho_cert,
-        cert_slack=cert_slack)
+        noise_certificate=noise_certificate, seed_done=seed_done,
+        rho_cert=rho_cert, cert_slack=cert_slack)
     table = ResultTable({
         "DM": trial_dms,
         "max": maxvalues,

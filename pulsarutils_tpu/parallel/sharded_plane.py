@@ -60,9 +60,11 @@ def _spectral_program(mesh, axis, tsamp, max_harmonics, fmin, fmax):
             [one(rows[lo:min(lo + chunk, n)])
              for lo in range(0, n, chunk)], axis=1)
 
-    return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(P(axis, None),),
-                                 out_specs=P(None, axis)))
+    from .mesh import shard_map_compat
+
+    return jax.jit(shard_map_compat(local, mesh=mesh,
+                                    in_specs=(P(axis, None),),
+                                    out_specs=P(None, axis)))
 
 
 @functools.lru_cache(maxsize=16)
@@ -101,9 +103,11 @@ def _h_program(mesh, axis, window, nmax):
         h, m = h_test_batch(counts, nmax=nmax, xp=jnp)
         return h.astype(jnp.float32), m.astype(jnp.int32)
 
-    return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(P(axis, None), P(axis)),
-                                 out_specs=(P(axis), P(axis))))
+    from .mesh import shard_map_compat
+
+    return jax.jit(shard_map_compat(local, mesh=mesh,
+                                    in_specs=(P(axis, None), P(axis)),
+                                    out_specs=(P(axis), P(axis))))
 
 
 @functools.lru_cache(maxsize=16)
@@ -119,9 +123,11 @@ def _decim_program(mesh, axis, factor):
     def local(rows):
         return quick_resample(rows, factor, xp=jnp)
 
-    return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(P(axis, None),),
-                                 out_specs=P(axis, None)))
+    from .mesh import shard_map_compat
+
+    return jax.jit(shard_map_compat(local, mesh=mesh,
+                                    in_specs=(P(axis, None),),
+                                    out_specs=P(axis, None)))
 
 
 class ShardedPlane:

@@ -98,7 +98,8 @@ def iter_chunk_starts(nsamples, plan, tmin=0, sample_time=None):
 
 def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   *, backend="jax", snr_threshold=6.0, trial_dms=None,
-                  dm_block=None, chan_block=None, budget=None):
+                  dm_block=None, chan_block=None, budget=None, mesh=None,
+                  kernel="auto"):
     """Search an iterable of ``(istart, (nchan, step))`` chunks.
 
     One compiled executable serves every distinct chunk shape; interior
@@ -109,17 +110,54 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     ``snr_threshold`` (the reference's candidate criterion,
     ``clean.py:349``), plus the full tables for diagnostics.
 
+    ``mesh`` (with ``backend="jax"``) routes every chunk through the
+    sharded multi-device searches, the same routing rule as the full
+    pipeline driver (``kernel="hybrid"`` -> the fused
+    :func:`~.sharded_fdmt.sharded_hybrid_search` — one ``shard_map``
+    dispatch per typical hit chunk, round 6 — ``"fdmt"`` -> the
+    DM-sliced tree, anything else -> the ``(dm, chan)`` exact sweep).
+    The sharded searches re-derive the chunk-geometry plan from a
+    per-geometry cache, so interior chunks share one compiled program
+    AND one host-side offset table.
+
     ``budget`` (a
     :class:`~pulsarutils_tpu.utils.logging_utils.BudgetAccountant`)
     opens one chunk budget per chunk: the search's dispatch/readback
-    buckets land per chunk, and a compile observed on any chunk after
-    the first is flagged as a retrace (the one-executable contract above
-    is *checked*, not assumed — round 6).
+    buckets land per chunk — on the mesh route too, attributed by the
+    sharded searches exactly as single-device — and a compile observed
+    on any chunk after the first is flagged as a retrace (the
+    one-executable contract above is *checked*, not assumed — round 6).
     """
     import contextlib
 
     if budget is not None:
         budget.begin_stream()
+
+    def run_one(chunk):
+        if mesh is not None and backend == "jax":
+            if kernel == "hybrid":
+                from .sharded_fdmt import sharded_hybrid_search
+
+                return sharded_hybrid_search(
+                    chunk, dmmin, dmmax, start_freq, bandwidth,
+                    sample_time, mesh=mesh)
+            if kernel == "fdmt":
+                from .sharded_fdmt import sharded_fdmt_search
+
+                return sharded_fdmt_search(
+                    chunk, dmmin, dmmax, start_freq, bandwidth,
+                    sample_time, mesh=mesh)
+            from .sharded import sharded_dedispersion_search
+
+            return sharded_dedispersion_search(
+                chunk, dmmin, dmmax, start_freq, bandwidth, sample_time,
+                mesh=mesh, trial_dms=trial_dms, chan_block=chan_block)
+        return dedispersion_search(
+            chunk, dmmin, dmmax, start_freq, bandwidth, sample_time,
+            backend=backend, trial_dms=trial_dms, dm_block=dm_block,
+            chan_block=chan_block,
+            **({} if kernel == "auto" else {"kernel": kernel}))
+
     results = []
     hits = []
     for istart, chunk in chunks:
@@ -128,10 +166,7 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
         with ctx:
             with (budget.bucket("search") if budget is not None
                   else contextlib.nullcontext()):
-                table = dedispersion_search(
-                    chunk, dmmin, dmmax, start_freq, bandwidth,
-                    sample_time, backend=backend, trial_dms=trial_dms,
-                    dm_block=dm_block, chan_block=chan_block)
+                table = run_one(chunk)
             results.append((istart, table))
             best = table.best_row()
             if best["snr"] > snr_threshold:
@@ -174,14 +209,20 @@ def _ring_kernel(mesh, n_hops, rotation):
             # rotate the ring: this device's view advances one block right
             return acc, nxt, jax.lax.ppermute(nxt, "time", perm=perm)
 
-        acc0 = jax.lax.pcast(jnp.zeros((ndm, t_loc), dtype=data_local.dtype),
-                             "time", to="varying")
+        acc0 = jnp.zeros((ndm, t_loc), dtype=data_local.dtype)
+        if hasattr(jax.lax, "pcast"):
+            # newer jax tracks varying-mesh-axes: a zeros-constant carry
+            # is UNVARYING while the body's sum varies over the mesh,
+            # and fori_loop rejects the carry-type mismatch
+            acc0 = jax.lax.pcast(acc0, "time", to="varying")
         nxt0 = jax.lax.ppermute(data_local, "time", perm=perm)
         acc, _, _ = jax.lax.fori_loop(0, n_hops, hop,
                                       (acc0, data_local, nxt0))
         return acc
 
-    fn = jax.shard_map(
+    from .mesh import shard_map_compat
+
+    fn = shard_map_compat(
         local_step,
         mesh=mesh,
         in_specs=(P(None, "time"), P(None, None)),

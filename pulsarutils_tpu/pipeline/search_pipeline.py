@@ -62,7 +62,14 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
     ``"fdmt"`` -> :func:`..parallel.sharded_fdmt.sharded_fdmt_search`,
     anything else -> the DM x chan sharded exact sweep).  ``snr_floor``
     reaches the hybrid searches (single- and multi-device) so the noise
-    certificate can fire on signal-free chunks.
+    certificate can fire on signal-free chunks.  Round 6: a floorless
+    mesh hybrid chunk (the common streaming configuration — thresholds
+    below the certifiable floor resolve to ``snr_floor=None``) runs its
+    whole first round as ONE fused ``shard_map`` dispatch, with the
+    guarantee loop as the escape hatch; with a certificate-mode floor
+    the two-stage composition is kept deliberately, so a certified
+    chunk pays one coarse dispatch and no seed rescore — the same
+    gating as the single-device fused path.
     """
     state = state if state is not None else {}
     bk = state.get("backend", backend)
@@ -169,13 +176,17 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
 
     ``mesh`` (a ``jax.sharding.Mesh``) routes every chunk through the
     multi-device sharded searches — the same device-resident chunk is
-    searched by all devices (DM-sliced coarse stage + sharded exact
-    rescore for ``kernel="hybrid"``).  ``make_plots``/``period_search``
-    work on the mesh path too: the captured plane stays DM-sharded and
-    device-resident, the periodicity spectra and the figure's per-row
-    H curve are computed shard-locally, and only per-row score vectors,
-    a decimated image and single rows are gathered
-    (:mod:`..parallel.sharded_plane`).
+    searched by all devices (for ``kernel="hybrid"`` the DM-sliced
+    coarse stage, seed selection and exact seed/need rescore run as ONE
+    fused ``shard_map`` dispatch on floorless chunks, round 6; the
+    per-chunk dispatch/readback trip counts land in the chunk budget
+    exactly as on the single-device path, so the ``BUDGET_JSON`` footer
+    prices the mesh route's tunnel trips honestly).
+    ``make_plots``/``period_search`` work on the mesh path too: the
+    captured plane stays DM-sharded and device-resident, the
+    periodicity spectra and the figure's per-row H curve are computed
+    shard-locally, and only per-row score vectors, a decimated image
+    and single rows are gathered (:mod:`..parallel.sharded_plane`).
 
     ``show_plots=True`` additionally displays each diagnostic figure in
     an interactive window (the reference's ``show=True`` behaviour,

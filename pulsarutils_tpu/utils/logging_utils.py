@@ -266,6 +266,15 @@ class BudgetAccountant(StageTimer):
         with self._async_lock:
             self.async_totals[name] = self.async_totals.get(name, 0.0) + dt
 
+    def trips(self):
+        """Total device round trips counted so far (``dispatches`` +
+        ``readbacks`` over all chunks) — the quantity the RTT floor
+        prices, and the number the mesh fused-hybrid A/B pins (one
+        fused ``shard_map`` program per typical hit chunk vs one coarse
+        dispatch plus one per rescore bucket)."""
+        return (self.counters_total.get("dispatches", 0)
+                + self.counters_total.get("readbacks", 0))
+
     # -- reporting -----------------------------------------------------------
 
     def to_json(self, max_per_chunk=32):
@@ -297,11 +306,9 @@ class BudgetAccountant(StageTimer):
         if nchunks > max_per_chunk:
             out["per_chunk_truncated"] = True
         if self.rtt_s is not None:
-            trips = (self.counters_total.get("dispatches", 0)
-                     + self.counters_total.get("readbacks", 0))
             out["rtt_s"] = round(self.rtt_s, 6)
-            out["trips"] = trips
-            out["trips_x_rtt_s"] = round(trips * self.rtt_s, 3)
+            out["trips"] = self.trips()
+            out["trips_x_rtt_s"] = round(self.trips() * self.rtt_s, 3)
         return out
 
     def footer(self, log=logger):

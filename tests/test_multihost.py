@@ -71,6 +71,12 @@ def test_two_process_cluster_live():
     reference — the only test in the suite where ``jax.process_count()
     > 1`` branches actually execute (it found the non-addressable-fetch
     bug in ``sharded.py``).  ~1 min: two fresh jax processes compile.
+
+    Exit code 3 is the orchestrator's explicit "cluster formed but this
+    jaxlib cannot EXECUTE multiprocess computations on the CPU backend"
+    verdict (e.g. jaxlib 0.4.x): recorded as a skip with the reason on
+    display, not a failure — and not silently, so an environment where
+    the live check COULD run never skips it.
     """
     import os
     import subprocess
@@ -82,5 +88,8 @@ def test_two_process_cluster_live():
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "tools", "multihost_live.py")],
         capture_output=True, text=True, timeout=600, cwd=root, env=env)
+    if proc.returncode == 3:
+        pytest.skip("multiprocess execution unsupported by this jaxlib's "
+                    "CPU backend (cluster bring-up itself succeeded)")
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "MULTIHOST LIVE: OK" in proc.stdout

@@ -1,0 +1,188 @@
+"""Fused mesh hybrid: coarse + seed + rescore in ONE shard_map dispatch.
+
+The ISSUE-2 contract on the 8-virtual-device CPU mesh: the fused
+program's result — argbest, ``exact`` column, certificate metadata —
+is bit-for-bit the unfused escape-hatch path's, and the dispatch
+counter drops to 1 fused program (+ bounded follow-ups) for a typical
+hit chunk, pinned through the BudgetAccountant so dispatch creep fails
+tier-1 instead of only showing up on hardware.
+"""
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.models.simulate import simulate_test_data
+from pulsarutils_tpu.ops.search import dedispersion_search
+from pulsarutils_tpu.parallel.mesh import make_mesh
+from pulsarutils_tpu.parallel.sharded_fdmt import sharded_hybrid_search
+from pulsarutils_tpu.utils.logging_utils import BudgetAccountant
+
+
+@pytest.fixture(scope="module")
+def sim():
+    # same strong-pulse chunk as TestShardedFdmt's hybrid test: a
+    # "typical hit chunk" whose seed/need sets fit the device buckets
+    return simulate_test_data(150, nchan=64, nsamples=4096, signal=2.0,
+                              noise=0.4, rng=51)
+
+
+def _args(header):
+    return (100, 200.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4), (1, 1)])
+def test_fused_matches_unfused_bitwise(sim, shape):
+    """The acceptance contract: identical argbest, ``exact`` column and
+    cert metadata vs the unfused path — and in fact identical scores,
+    since the fused rescore shares the escape hatch's per-shard kernel,
+    channel split and psum order."""
+    array, header = sim
+    mesh = make_mesh(shape, ("dm", "chan"))
+    t_f = sharded_hybrid_search(array, *_args(header), mesh=mesh)
+    t_u = sharded_hybrid_search(array, *_args(header), mesh=mesh,
+                                fused=False)
+    assert t_f.argbest() == t_u.argbest()
+    assert np.array_equal(t_f["exact"], t_u["exact"])
+    for col in ("DM", "max", "std", "snr", "rebin", "peak", "cert"):
+        assert np.array_equal(np.asarray(t_f[col]), np.asarray(t_u[col])), col
+    assert t_f.meta == t_u.meta
+    assert bool(t_f["exact"][t_f.argbest()])
+
+
+def test_fused_matches_numpy_reference(sim):
+    """Exact-argbest contract against the reference semantics."""
+    array, header = sim
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    t_h = sharded_hybrid_search(array, *_args(header), mesh=mesh)
+    t_np = dedispersion_search(array, *_args(header), backend="numpy")
+    best = t_np.argbest("snr")
+    assert t_h.argbest("snr") == best
+    assert bool(t_h["exact"][best])
+    assert t_h["DM"][best] == t_np["DM"][best]
+    assert t_h["rebin"][best] == t_np["rebin"][best]
+    assert np.isclose(t_h["snr"][best], t_np["snr"][best], rtol=1e-3)
+
+
+def test_fused_dispatch_count_pinned(sim):
+    """Dispatch-count regression pin (ISSUE-2 satellite): one fused
+    program + one packed readback for a typical hit chunk, zero
+    escape-hatch rescore calls — vs the unfused path's coarse dispatch
+    plus one per rescore bucket."""
+    array, header = sim
+    mesh = make_mesh((8, 1), ("dm", "chan"))
+    # compile outside the counted chunks (compiles are tracked
+    # separately; this test pins steady-state dispatch counts)
+    sharded_hybrid_search(array, *_args(header), mesh=mesh)
+    sharded_hybrid_search(array, *_args(header), mesh=mesh, fused=False)
+
+    acct = BudgetAccountant()
+    with acct.chunk("fused"):
+        t = sharded_hybrid_search(array, *_args(header), mesh=mesh)
+    c = acct.chunks[0]["counters"]
+    assert c["dispatches"] == 1
+    assert c["readbacks"] == 1
+    assert "rescore_calls" not in c
+    assert bool(t["exact"][t.argbest()])
+    assert acct.trips() == 2
+
+    acct_u = BudgetAccountant()
+    with acct_u.chunk("unfused"):
+        sharded_hybrid_search(array, *_args(header), mesh=mesh,
+                              fused=False)
+    c_u = acct_u.chunks[0]["counters"]
+    # coarse + at least one rescore-bucket dispatch — the overhead the
+    # fused program removes
+    assert c_u["dispatches"] >= 2
+    assert c_u["rescore_calls"] >= 1
+
+
+def test_fused_floor_no_certificate_parity(sim):
+    """snr_floor with the certificate opted out is fused-eligible (the
+    certified-chunk economics don't apply); the contract must still
+    match the unfused path bit for bit."""
+    array, header = sim
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    kw = dict(snr_floor=8.0, noise_certificate=False)
+    t_f = sharded_hybrid_search(array, *_args(header), mesh=mesh, **kw)
+    t_u = sharded_hybrid_search(array, *_args(header), mesh=mesh,
+                                fused=False, **kw)
+    assert t_f.argbest() == t_u.argbest()
+    assert np.array_equal(t_f["exact"], t_u["exact"])
+    assert np.array_equal(np.asarray(t_f["snr"]), np.asarray(t_u["snr"]))
+    assert t_f.meta == t_u.meta
+
+
+def test_fused_gating_and_force_flag(sim):
+    """Certificate-mode floors keep the two-stage path (a certified
+    chunk must pay one coarse dispatch, not a burned seed rescore), and
+    fused=True surfaces the ineligibility instead of silently degrading."""
+    array, header = sim
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    with pytest.raises(ValueError, match="certificate mode"):
+        sharded_hybrid_search(array, *_args(header), mesh=mesh,
+                              snr_floor=12.0, fused=True)
+    with pytest.raises(ValueError, match="legacy margins"):
+        sharded_hybrid_search(array, *_args(header), mesh=mesh,
+                              rho_cert=False, fused=True)
+
+
+def test_rescore_bucket_reuse_no_retrace(sim):
+    """ISSUE-2 satellite: repeat same-geometry rescore-bucket calls must
+    reuse the compiled program (no silent retrace — asserted via the
+    existing retrace detector) and must not rebuild the host offset
+    table when the caller supplies slices of a cached one."""
+    from pulsarutils_tpu.ops.plan import dedispersion_plan
+    from pulsarutils_tpu.ops.search import _offsets_for
+    from pulsarutils_tpu.parallel.sharded import sharded_dedispersion_search
+
+    array, header = sim
+    nchan, nsamples = array.shape
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    trial_dms = np.asarray(dedispersion_plan(
+        nchan, 100, 200.0, header["fbottom"], header["bandwidth"],
+        header["tsamp"]), dtype=np.float64)
+    offsets = _offsets_for(trial_dms, nchan, header["fbottom"],
+                           header["bandwidth"], header["tsamp"], nsamples)
+
+    acct = BudgetAccountant()
+    acct.begin_stream()
+    for i, lo in enumerate((0, 8, 16)):
+        rows = np.arange(lo, lo + 8)
+        with acct.chunk(i):
+            sharded_dedispersion_search(
+                array, 100, 200.0, header["fbottom"], header["bandwidth"],
+                header["tsamp"], mesh=mesh, trial_dms=trial_dms[rows],
+                offsets=offsets[rows])
+    # chunk 0 may compile the bucket program once; identical-geometry
+    # repeats must hit the jit cache
+    assert not any(rec.get("retrace") for rec in acct.chunks[1:])
+    # the supplied-offsets path never re-derives the plan shifts
+    assert all("offset_tables" not in rec["counters"]
+               for rec in acct.chunks)
+
+
+def test_offsets_shape_validation(sim):
+    from pulsarutils_tpu.parallel.sharded import sharded_dedispersion_search
+
+    array, header = sim
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    with pytest.raises(ValueError, match="offsets shape"):
+        sharded_dedispersion_search(
+            array, 100, 200.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"], mesh=mesh, trial_dms=np.array([150.0]),
+            offsets=np.zeros((2, array.shape[0]), np.int32))
+
+
+@pytest.mark.slow
+def test_fused_scaling_sweep(sim):
+    """8-device scaling sweep (CPU virtual mesh adds no parallel
+    capacity — this checks correctness of every device count, not
+    speed); marked slow so tier-1 wall clock stays bounded."""
+    array, header = sim
+    t_ref = dedispersion_search(array, *_args(header), backend="numpy")
+    best = t_ref.argbest("snr")
+    for n in (1, 2, 4, 8):
+        mesh = make_mesh((n, 1), ("dm", "chan"))
+        t = sharded_hybrid_search(array, *_args(header), mesh=mesh)
+        assert t.argbest("snr") == best, n
+        assert np.isclose(t["snr"][best], t_ref["snr"][best], rtol=1e-3), n

@@ -18,6 +18,12 @@ Usage:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python tools/mesh_overhead_r5.py
   python tools/mesh_overhead_r5.py --tpu
+
+NOTE (round 6): the +0.264 s (1,1)-mesh overhead this tool measured is
+the multi-dispatch composition's; ``tools/mesh_fused_ab.py`` is the
+successor probe that A/Bs it against the fused one-dispatch sharded
+hybrid (with BudgetAccountant trip counters) — use that for new
+measurements.
 """
 
 import argparse

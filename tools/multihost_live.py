@@ -70,8 +70,20 @@ def rank_main(rank):
 
     mesh = multihost.pod_mesh()
     assert mesh.devices.size == 8
-    table = sharded.sharded_dedispersion_search(np.asarray(array), *args,
-                                                mesh=mesh)
+    try:
+        table = sharded.sharded_dedispersion_search(np.asarray(array), *args,
+                                                    mesh=mesh)
+    except Exception as exc:
+        if "Multiprocess computations aren't implemented" in str(exc):
+            # some jaxlib builds (e.g. 0.4.x CPU) form the Gloo cluster
+            # but cannot EXECUTE cross-process computations on the CPU
+            # backend.  Distinct exit code -> the test suite records an
+            # explicit environment skip instead of a fake failure; the
+            # live check still runs fully wherever the backend supports
+            # it.
+            print(f"rank {rank}: UNSUPPORTED backend: {exc}", flush=True)
+            sys.exit(3)
+        raise
     ref = dedispersion_search(np.asarray(array), *args, backend="numpy")
     assert table.nrows == ref.nrows
     best, best_ref = table.argbest("snr"), ref.argbest("snr")
@@ -100,14 +112,21 @@ def main():
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    rc = 0
+    rcs = []
     for r, p in enumerate(procs):
         out, _ = p.communicate(timeout=600)
         tail = "\n".join(out.strip().splitlines()[-3:])
         print(f"--- rank {r} (rc={p.returncode}) ---\n{tail}", flush=True)
-        rc |= p.returncode
-    print("MULTIHOST LIVE:", "OK" if rc == 0 else "FAILED", flush=True)
-    return rc
+        rcs.append(p.returncode)
+    if any(rc not in (0, 3) for rc in rcs):
+        print("MULTIHOST LIVE: FAILED", flush=True)
+        return 1
+    if 3 in rcs:  # see rank_main: backend cannot execute multiprocess
+        print("MULTIHOST LIVE: UNSUPPORTED BACKEND (cluster formed, "
+              "execution unavailable)", flush=True)
+        return 3
+    print("MULTIHOST LIVE: OK", flush=True)
+    return 0
 
 
 if __name__ == "__main__":
