@@ -35,7 +35,13 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+#: every record emit() printed this run, in order — --metrics-out writes
+#: them as the machine-readable snapshot tools/perf_gate.py compares
+RECORDS = []
+
+
 def emit(obj):
+    RECORDS.append(obj)
     print(json.dumps(obj), flush=True)
 
 
@@ -474,6 +480,11 @@ def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8])
+    parser.add_argument("--metrics-out", default=None, metavar="PATH",
+                        help="write every config's JSON record plus a "
+                             "final metrics-registry line to PATH (JSON "
+                             "lines) — the snapshot tools/perf_gate.py "
+                             "compares against a committed baseline")
     opts = parser.parse_args(argv)
     quick = os.environ.get("BENCH_PRESET") == "quick"
     try:  # persistent compile cache (big-shape compiles run minutes cold)
@@ -493,6 +504,16 @@ def main(argv=None):
         except Exception as exc:
             traceback.print_exc()
             emit({"config": c, "error": f"{type(exc).__name__}: {exc}"})
+    if opts.metrics_out:
+        from pulsarutils_tpu.obs.metrics import REGISTRY
+
+        with open(opts.metrics_out, "w") as f:
+            for rec in RECORDS:
+                f.write(json.dumps(rec) + "\n")
+            # registry tail: counters/gauges/histograms the configs'
+            # pipeline runs accumulated (ignored by the gate's loader)
+            f.write(json.dumps({"metrics": REGISTRY.snapshot()}) + "\n")
+        log(f"metrics snapshot -> {opts.metrics_out}")
 
 
 if __name__ == "__main__":

@@ -82,6 +82,18 @@ def build_parser():
     parser.add_argument("--no-sift", action="store_true",
                         help="skip duplicate-candidate sifting (the 50%% "
                              "chunk overlap detects each pulse twice)")
+    parser.add_argument("--trace", default=None, metavar="OUT.json",
+                        help="write a Chrome/Perfetto trace of the run's "
+                             "spans to this path AND a jax.profiler "
+                             "device trace to '<OUT.json>_device/' (one "
+                             "flag, both traces), and enable per-kernel "
+                             "roofline accounting for the run")
+    parser.add_argument("--metrics-out", default=None, metavar="PATH",
+                        help="write the run's metrics-registry snapshot "
+                             "(counters/gauges/histograms: candidates, "
+                             "trips, bytes moved, roofline, memory "
+                             "watermarks) to PATH — Prometheus textfile "
+                             "format for a .prom suffix, JSONL otherwise")
     return parser
 
 
@@ -102,12 +114,23 @@ def _enable_compile_cache():
 
 
 def main(args=None):
+    import contextlib
+
     opts = build_parser().parse_args(args)
     if opts.backend == "jax":
         _enable_compile_cache()
+    if opts.trace:
+        from ..obs import roofline, trace
+
+        roofline.enable()  # a traced run is an observability run
+        session = trace.trace_session(
+            path=opts.trace, device_trace_dir=opts.trace + "_device")
+    else:
+        session = contextlib.nullcontext()
     total_raw = 0
     total_cands = 0
-    for fname in opts.fnames:
+    with session:
+      for fname in opts.fnames:
         hits, _ = search_by_chunks(
             fname,
             chunk_length=opts.chunk_length,
@@ -146,6 +169,14 @@ def main(args=None):
             total_cands += len(hits)
     logger.info("total candidates: %d (%d raw detections)",
                 total_cands, total_raw)
+    if opts.metrics_out:
+        from ..obs.metrics import REGISTRY
+
+        if opts.metrics_out.endswith(".prom"):
+            n = REGISTRY.write_prometheus(opts.metrics_out)
+        else:
+            n = REGISTRY.write_jsonl(opts.metrics_out)
+        logger.info("metrics: %d lines -> %s", n, opts.metrics_out)
     return 0
 
 

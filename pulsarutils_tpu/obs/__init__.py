@@ -1,0 +1,44 @@
+"""Survey telemetry: span tracing, metrics registry, roofline + memory
+accounting, and the perf-regression gate's comparison logic.
+
+Three pillars (ISSUE 3):
+
+* :mod:`.trace` — lightweight wall-clock **spans** (context manager +
+  explicit async completion), exported as Chrome trace-event JSON
+  (loadable in Perfetto).  The
+  :class:`~pulsarutils_tpu.utils.logging_utils.BudgetAccountant` is a
+  *consumer* of span durations — one timing primitive, two views
+  (per-chunk budget buckets and the event timeline);
+* :mod:`.metrics` — process-wide counters / gauges / histograms with
+  JSONL and Prometheus-textfile exporters;
+* :mod:`.roofline` + :mod:`.memory` — per-dispatch FLOPs/bytes from
+  ``compiled.cost_analysis()`` against measured span wall (achieved
+  fraction of ideal per kernel), and device-memory watermarks per chunk.
+
+:mod:`.gate` holds the perf-regression comparison consumed by
+``tools/perf_gate.py``.
+
+Everything here is dependency-light (stdlib + lazy jax) and safe to
+import before a JAX backend exists.
+"""
+
+from . import gate, memory, metrics, roofline, trace
+from .metrics import REGISTRY
+from .trace import (begin_span, is_tracing, set_track, span, start_tracing,
+                    stop_tracing, trace_session)
+
+__all__ = [
+    "REGISTRY",
+    "begin_span",
+    "gate",
+    "is_tracing",
+    "memory",
+    "metrics",
+    "roofline",
+    "set_track",
+    "span",
+    "start_tracing",
+    "stop_tracing",
+    "trace",
+    "trace_session",
+]

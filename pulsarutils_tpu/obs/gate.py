@@ -1,0 +1,140 @@
+"""Perf-regression gate: compare a fresh bench snapshot to a baseline.
+
+``bench_suite.py --metrics-out`` writes one JSON record per config (the
+same objects it prints) plus a final metrics-registry line; a **gate
+baseline** is simply a committed snapshot of that file.  The comparison
+here is deliberately narrow and direction-aware:
+
+* each config's headline ``value`` is compared against the baseline's,
+  with a per-config relative tolerance (CPU shared-runner jitter is
+  real: the default tolerance is generous — the gate exists to catch
+  regressions in kind, 2x-10x cliffs, not 5% noise);
+* direction comes from the record's ``unit``: throughput units
+  (``.../sec``) must not drop, latency units (``s/chunk``, ``s (wall``)
+  must not grow, and counter units (``trips saved``) must not drop;
+* a config present in the baseline but missing (or errored) in the
+  fresh snapshot is itself a failure — a bench that stops running is a
+  regression, not a skip.
+
+``tools/perf_gate.py`` is the CLI; this module is imported by tests so
+the decision logic is unit-testable without running the suite.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["DEFAULT_REL_TOL", "load_snapshot", "lower_is_better",
+           "compare", "format_report"]
+
+#: default relative tolerance — CPU wall-clock on shared runners jitters
+#: by tens of percent; the gate targets step regressions (2x+), so a
+#: miss must exceed baseline by 60% (latency) / fall below 40% of it
+#: (throughput) before failing
+DEFAULT_REL_TOL = 0.6
+
+#: unit prefixes meaning "smaller is better"
+_LATENCY_PREFIXES = ("s/", "s (", "seconds")
+
+
+def lower_is_better(unit):
+    """Direction from the record's unit string."""
+    unit = (unit or "").strip().lower()
+    return unit.startswith(_LATENCY_PREFIXES)
+
+
+def load_snapshot(path):
+    """Parse a ``--metrics-out`` snapshot (JSON lines) into
+    ``{config_number: record}``.  Error records (``{"config": n,
+    "error": ...}``) are kept — :func:`compare` fails them explicitly.
+    Lines without a ``config`` key (the metrics-registry tail) are
+    ignored."""
+    records = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "config" in rec:
+                records[int(rec["config"])] = rec
+    return records
+
+
+def compare(baseline, fresh, rel_tol=DEFAULT_REL_TOL, per_config_tol=None,
+            configs=None):
+    """Compare snapshots; returns ``(ok, rows)``.
+
+    ``baseline``/``fresh``: ``{config: record}`` as from
+    :func:`load_snapshot`.  ``configs`` restricts the comparison (default:
+    every config the baseline holds).  ``per_config_tol`` maps config
+    number → relative tolerance, overriding ``rel_tol``.
+
+    Each row: ``{"config", "unit", "baseline", "fresh", "ratio",
+    "tolerance", "lower_is_better", "status", "detail"}`` with status
+    ``ok`` / ``regressed`` / ``missing`` / ``error``.
+    """
+    per_config_tol = per_config_tol or {}
+    rows = []
+    ok = True
+    for cfg in sorted(configs if configs is not None else baseline):
+        cfg = int(cfg)
+        base = baseline.get(cfg)
+        tol = float(per_config_tol.get(cfg, rel_tol))
+        row = {"config": cfg, "tolerance": tol, "baseline": None,
+               "fresh": None, "ratio": None, "unit": None,
+               "lower_is_better": None, "status": "ok", "detail": ""}
+        rows.append(row)
+        if base is None or "value" not in base:
+            row["status"] = "error"
+            row["detail"] = "baseline has no value for this config"
+            ok = False
+            continue
+        row["unit"] = base.get("unit")
+        row["baseline"] = float(base["value"])
+        lib = lower_is_better(base.get("unit"))
+        row["lower_is_better"] = lib
+        rec = fresh.get(cfg)
+        if rec is None:
+            row["status"] = "missing"
+            row["detail"] = "config absent from fresh snapshot"
+            ok = False
+            continue
+        if "error" in rec or "value" not in rec:
+            row["status"] = "error"
+            row["detail"] = str(rec.get("error", "record has no value"))
+            ok = False
+            continue
+        row["fresh"] = float(rec["value"])
+        if row["baseline"] == 0:
+            row["ratio"] = None  # nothing sane to normalise by
+            continue
+        ratio = row["fresh"] / row["baseline"]
+        row["ratio"] = round(ratio, 4)
+        if lib:
+            regressed = ratio > 1.0 + tol
+        else:
+            regressed = ratio < 1.0 - tol
+        if regressed:
+            row["status"] = "regressed"
+            row["detail"] = (f"{'grew' if lib else 'fell'} to "
+                             f"{100 * ratio:.0f}% of baseline "
+                             f"(tolerance {100 * tol:.0f}%)")
+            ok = False
+    return ok, rows
+
+
+def format_report(rows):
+    """Human-readable gate report (one line per config)."""
+    lines = ["perf gate:"]
+    for r in rows:
+        direction = ("lower" if r["lower_is_better"]
+                     else "higher" if r["lower_is_better"] is not None
+                     else "?")
+        lines.append(
+            f"  config {r['config']:>2}  {r['status']:<10}"
+            f" baseline={r['baseline']} fresh={r['fresh']}"
+            f" ratio={r['ratio']} ({direction}-is-better,"
+            f" tol {100 * r['tolerance']:.0f}%)"
+            + (f"  {r['detail']}" if r["detail"] else ""))
+    return "\n".join(lines)

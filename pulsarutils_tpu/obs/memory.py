@@ -1,0 +1,83 @@
+"""Device-memory accounting: per-chunk watermarks and HBM headroom.
+
+Two sources, best first:
+
+* ``device.memory_stats()`` — the allocator's own ``bytes_in_use`` /
+  ``peak_bytes_in_use`` / ``bytes_limit`` (TPU/GPU backends);
+* ``jax.live_arrays()`` — the sum of live committed array bytes, the
+  portable fallback (CPU backends report ``memory_stats() = None``).
+  It undercounts allocator overhead and donation slack but tracks the
+  quantity the streaming driver actually controls: how many chunk-sized
+  buffers are alive at once.
+
+:func:`record_watermark` is called once per chunk by the streaming
+driver; the registry gauges it maintains (``putpu_device_bytes_in_use``,
+``putpu_device_bytes_peak``, ``putpu_device_bytes_limit``,
+``putpu_device_headroom_bytes``) make HBM headroom a tracked series
+instead of an OOM surprise.
+"""
+
+from __future__ import annotations
+
+from . import metrics
+
+__all__ = ["device_memory_snapshot", "record_watermark"]
+
+
+def device_memory_snapshot():
+    """Aggregate device-memory state across addressable devices.
+
+    Returns ``{"source", "bytes_in_use", "peak_bytes_in_use",
+    "bytes_limit"}`` (the last two ``None`` on the live-array fallback),
+    or ``None`` when no jax backend is importable.
+    """
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    in_use = peak = limit = 0
+    have_stats = False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            have_stats = True
+            in_use += int(stats.get("bytes_in_use", 0))
+            peak += int(stats.get("peak_bytes_in_use",
+                                  stats.get("bytes_in_use", 0)))
+            limit += int(stats.get("bytes_limit", 0))
+    if have_stats:
+        return {"source": "memory_stats", "bytes_in_use": in_use,
+                "peak_bytes_in_use": peak,
+                "bytes_limit": limit or None}
+    try:
+        live = sum(int(a.nbytes) for a in jax.live_arrays())
+    except Exception:
+        return None
+    return {"source": "live_arrays", "bytes_in_use": live,
+            "peak_bytes_in_use": None, "bytes_limit": None}
+
+
+def record_watermark():
+    """Snapshot device memory into the registry gauges; returns the
+    snapshot (or ``None``).  ``putpu_device_bytes_peak`` keeps the max
+    seen this process, so the run's high-water mark survives transient
+    dips; headroom is limit − in_use when the allocator reports a limit.
+    """
+    snap = device_memory_snapshot()
+    if snap is None:
+        return None
+    in_use = snap["bytes_in_use"]
+    metrics.gauge("putpu_device_bytes_in_use").set(in_use)
+    metrics.gauge("putpu_device_bytes_peak").set_max(
+        snap["peak_bytes_in_use"] if snap["peak_bytes_in_use"] is not None
+        else in_use)
+    if snap["bytes_limit"]:
+        metrics.gauge("putpu_device_bytes_limit").set(snap["bytes_limit"])
+        metrics.gauge("putpu_device_headroom_bytes").set(
+            snap["bytes_limit"] - in_use)
+    return snap

@@ -1,0 +1,319 @@
+"""Span tracing: the one wall-clock timing primitive of the framework.
+
+A **span** is a named interval measured with ``time.perf_counter``.
+Spans are cheap enough for hot paths (two clock reads; nothing else when
+no tracer is active) and serve two consumers at once:
+
+* the :class:`~pulsarutils_tpu.utils.logging_utils.BudgetAccountant`
+  reads each span's measured duration for its per-chunk bucket ledger
+  (the budget layer is a *consumer* of span events, not a parallel
+  bookkeeping system — round 7);
+* an active :class:`Tracer` records every completed span as a Chrome
+  trace event (``{"traceEvents": [...]}`` JSON), loadable in Perfetto /
+  ``chrome://tracing``, with one track per chunk (see :func:`set_track`)
+  and one per worker thread.
+
+Synchronous nesting is the common case (:func:`span`); device work that
+*completes* later than the call that launched it gets an **async span**
+(:func:`begin_span` → ``handle.end()``), which may finish on another
+thread and out of stack order — exactly how an async device dispatch
+relates to its block-until-ready readback.
+
+The module is stdlib-only and never imports jax; :func:`trace_session`
+drives ``jax.profiler`` lazily so one flag can emit both the span JSON
+and the XLA device trace into the same run directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import logging
+import threading
+import time
+
+logger = logging.getLogger("pulsarutils_tpu")
+
+#: the process-wide active tracer (None = tracing off).  A bare module
+#: global on purpose: reads must be one LOAD_GLOBAL in hot paths, and
+#: start/stop happen at run granularity, not per span.
+_TRACER = None
+
+#: logical track for spans on this (logical) thread of control — set per
+#: chunk by the budget accountant so each chunk renders as its own
+#: Perfetto track.  ContextVar, not thread-local: worker threads started
+#: per chunk inherit the chunk's context.
+_TRACK = contextvars.ContextVar("putpu_trace_track", default=None)
+
+
+class Span:
+    """One timed interval.  ``dur`` is valid after :func:`close_span`."""
+
+    __slots__ = ("name", "attrs", "t0", "t1", "dur")
+
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = attrs
+        self.t1 = self.dur = None
+        self.t0 = time.perf_counter()
+
+
+def open_span(name, attrs=None):
+    """Start a span NOW.  Pair with :func:`close_span` in a finally."""
+    return Span(name, attrs)
+
+
+def close_span(s, track=None):
+    """End ``s``; record it on the active tracer (if any).  Returns ``s``
+    with ``dur`` set — consumers (the budget accountant) read it from
+    there, so there is exactly one measurement per interval."""
+    s.t1 = time.perf_counter()
+    s.dur = s.t1 - s.t0
+    tr = _TRACER
+    if tr is not None:
+        tr.complete(s, track)
+    return s
+
+
+@contextlib.contextmanager
+def span(name, track=None, **attrs):
+    """Context manager form: ``with span("search", chunk=3): ...``.
+
+    Yields the :class:`Span` (its ``dur`` is set on exit).  ``track``
+    overrides the contextvar track for this one event.
+    """
+    s = open_span(name, attrs or None)
+    try:
+        yield s
+    finally:
+        close_span(s, track=track)
+
+
+class _NullAsync:
+    """Returned by :func:`begin_span` when tracing is off: free to end."""
+
+    __slots__ = ()
+
+    def end(self, **attrs):
+        pass
+
+
+_NULL_ASYNC = _NullAsync()
+
+
+class AsyncSpan:
+    """A span completed explicitly — possibly later, possibly on another
+    thread (device dispatch → readback, persist submit → worker done).
+    Emitted as a Chrome async ``b``/``e`` pair so it need not nest."""
+
+    __slots__ = ("name", "attrs", "track", "t0", "_tracer", "_id", "_done")
+
+    def __init__(self, name, attrs, track, tracer):
+        self.name = name
+        self.attrs = attrs
+        self.track = track
+        self._tracer = tracer
+        self._id = tracer.next_id()
+        self._done = False
+        self.t0 = time.perf_counter()
+        tracer.async_begin(self)
+
+    def end(self, **attrs):
+        """Complete the span (idempotent; safe after the tracer stopped)."""
+        if self._done:
+            return
+        self._done = True
+        self._tracer.async_end(self, time.perf_counter(), attrs or None)
+
+
+def begin_span(name, track=None, **attrs):
+    """Open an async span on the active tracer; no-op handle when
+    tracing is off (callers hold the handle and ``end()`` it blindly)."""
+    tr = _TRACER
+    if tr is None:
+        return _NULL_ASYNC
+    return AsyncSpan(name, attrs or None, track or _TRACK.get(), tr)
+
+
+@contextlib.contextmanager
+def set_track(name):
+    """Route spans in this context onto the named Perfetto track."""
+    token = _TRACK.set(name)
+    try:
+        yield
+    finally:
+        _TRACK.reset(token)
+
+
+def push_track(name):
+    """Non-contextmanager :func:`set_track` (pair with :func:`pop_track`)."""
+    return _TRACK.set(name)
+
+
+def pop_track(token):
+    _TRACK.reset(token)
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+class Tracer:
+    """Collects completed spans; exports Chrome trace-event JSON."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._tracks = {}       # track name -> tid (1-based, stable order)
+        self._seq = itertools.count(1)
+        self._closed = False
+        self.epoch = time.perf_counter()
+
+    def next_id(self):
+        return next(self._seq)
+
+    def _tid(self, track):
+        if track is None:
+            t = threading.current_thread()
+            track = ("main" if t is threading.main_thread()
+                     else t.name or f"thread-{t.ident}")
+        # locked check-then-insert: two threads first-using new tracks
+        # concurrently must not be assigned the same tid (merged rows)
+        with self._lock:
+            tid = self._tracks.get(track)
+            if tid is None:
+                tid = len(self._tracks) + 1
+                self._tracks[track] = tid
+        return tid
+
+    def _append(self, ev):
+        with self._lock:
+            if not self._closed:
+                self._events.append(ev)
+
+    def _ts(self, t):
+        return round((t - self.epoch) * 1e6, 3)  # perf_counter s -> us
+
+    def complete(self, s, track=None):
+        ev = {"name": s.name, "ph": "X", "pid": 1,
+              "tid": self._tid(track if track is not None
+                               else _TRACK.get()),
+              "ts": self._ts(s.t0), "dur": round(s.dur * 1e6, 3)}
+        if s.attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in s.attrs.items()}
+        self._append(ev)
+
+    def async_begin(self, a):
+        ev = {"name": a.name, "ph": "b", "cat": "async", "id": a._id,
+              "pid": 1, "tid": self._tid(a.track), "ts": self._ts(a.t0)}
+        if a.attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in a.attrs.items()}
+        self._append(ev)
+
+    def async_end(self, a, t1, attrs=None):
+        ev = {"name": a.name, "ph": "e", "cat": "async", "id": a._id,
+              "pid": 1, "tid": self._tid(a.track), "ts": self._ts(t1)}
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        self._append(ev)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+
+    # -- export --------------------------------------------------------------
+
+    def to_chrome(self):
+        """The Chrome trace-event dict (metadata + recorded events)."""
+        with self._lock:
+            events = list(self._events)
+            tracks = dict(self._tracks)
+        meta = [{"name": "process_name", "ph": "M", "pid": 1,
+                 "args": {"name": "pulsarutils_tpu"}}]
+        for track, tid in tracks.items():
+            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                         "tid": tid, "args": {"name": track}})
+            meta.append({"name": "thread_sort_index", "ph": "M", "pid": 1,
+                         "tid": tid, "args": {"sort_index": tid}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export(self, path):
+        """Write the trace JSON; returns the number of span events."""
+        doc = self.to_chrome()
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        n = sum(ev.get("ph") in ("X", "b") for ev in doc["traceEvents"])
+        logger.info("trace: %d spans on %d tracks -> %s",
+                    n, len(self._tracks), path)
+        return n
+
+
+def start_tracing():
+    """Install a fresh process-wide tracer and return it (replaces any
+    active one — the replaced tracer keeps its recorded events)."""
+    global _TRACER
+    tracer = Tracer()
+    _TRACER = tracer
+    return tracer
+
+
+def stop_tracing():
+    """Deactivate and return the current tracer (``None`` if inactive).
+    Late ``AsyncSpan.end()`` calls against it are dropped safely."""
+    global _TRACER
+    tracer = _TRACER
+    _TRACER = None
+    if tracer is not None:
+        tracer.close()
+    return tracer
+
+
+def active_tracer():
+    return _TRACER
+
+
+def is_tracing():
+    return _TRACER is not None
+
+
+@contextlib.contextmanager
+def trace_session(path=None, device_trace_dir=None):
+    """One flag, both traces (ISSUE 3 satellite): wraps a block in the
+    span tracer (exported to ``path`` as Chrome/Perfetto JSON) and — when
+    ``device_trace_dir`` is set — a ``jax.profiler`` device trace into
+    the same run directory.  Either side may be used alone;
+    ``utils.logging_utils.device_trace`` is the device-only spelling.
+
+    Yields the :class:`Tracer` (or ``None`` when ``path`` is unset).
+    Profiler failures degrade to a warning — observability must never
+    take down a survey run.
+    """
+    tracer = start_tracing() if path else None
+    profiling = False
+    if device_trace_dir:
+        try:
+            import jax
+
+            jax.profiler.start_trace(str(device_trace_dir))
+            profiling = True
+        except Exception as exc:
+            logger.warning("jax.profiler trace unavailable (%r); span "
+                           "trace unaffected", exc)
+    try:
+        yield tracer
+    finally:
+        if profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                logger.info("device trace -> %s", device_trace_dir)
+            except Exception as exc:
+                logger.warning("jax.profiler stop_trace failed: %r", exc)
+        if tracer is not None:
+            stop_tracing()
+            tracer.export(path)

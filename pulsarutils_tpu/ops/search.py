@@ -40,6 +40,7 @@ from .plan import (
     normalize_shifts,
 )
 from .rebin import block_sum_time
+from ..obs import roofline
 from ..utils.logging_utils import budget_bucket, budget_count
 from ..utils.table import ResultTable
 
@@ -496,6 +497,7 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                            use_score=_score_kernel_choice(use_pallas,
                                                           interpret),
                            deep_pair=_deep_pair_enabled())
+    roof = roofline.begin()
     with budget_bucket("search/coarse"):
         out = run(data)
         budget_count("dispatches")
@@ -506,6 +508,7 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     with budget_bucket("search/coarse_readback"):
         scores = unstack_scores(stacked)
         budget_count("readbacks")
+    roofline.end(roof, "fdmt_coarse", run, (data,))
     (maxvalues, stds, best_snrs, best_windows, best_peaks) = scores[:5]
     out = (trial_dms, maxvalues, stds, best_snrs, best_windows, best_peaks,
            plane_out)
@@ -577,13 +580,16 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
     offset_blocks = block_offsets(offsets, dm_block)
 
     gather_kernel = _jax_search_kernel(capture_plane, chan_block)
+    roof = roofline.begin()  # wall spans dispatch -> readback completion
     with budget_bucket("search/dispatch"):
-        out = gather_kernel(data, jnp.asarray(offset_blocks))
+        offs_dev = jnp.asarray(offset_blocks)  # attributed, not hoisted
+        out = gather_kernel(data, offs_dev)
         budget_count("dispatches")
     stacked = out[0] if capture_plane else out  # (nblocks, 5, dm_block)
     with budget_bucket("search/readback"):
         stacked = np.asarray(stacked)
         budget_count("readbacks")
+    roofline.end(roof, "gather_sweep", gather_kernel, (data, offs_dev))
     stacked = stacked.transpose(1, 0, 2).reshape(5, -1)[:, :ndm]
     (maxvalues, stds, best_snrs, best_windows,
      best_peaks) = unstack_scores(stacked)
@@ -1238,12 +1244,15 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
             deep_pair=_deep_pair_enabled())
         offs_dev = _device_offsets_cache(rebased_full.tobytes(),
                                          rebased_full.shape)
+        roof = roofline.begin()
         with budget_bucket("search/fused"):
-            packed = np.asarray(kernel(
-                data32, jnp.asarray(idx.astype(np.int32)), offs_dev,
-                jnp.asarray(cert_params)))
+            idx_dev = jnp.asarray(idx.astype(np.int32))
+            cert_dev = jnp.asarray(cert_params)
+            packed = np.asarray(kernel(data32, idx_dev, offs_dev, cert_dev))
             budget_count("dispatches")
             budget_count("readbacks")
+        roofline.end(roof, "fused_hybrid_seed", kernel,
+                     (data32, idx_dev, offs_dev, cert_dev))
         (coarse, sel, seed_scores, _, sel2, need_scores,
          n_need) = unpack_fused_hybrid(packed, ndm, bucket, bucket2)
         maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]

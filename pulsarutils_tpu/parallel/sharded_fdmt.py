@@ -644,12 +644,18 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         for it in tables:
             flat += [jnp.asarray(it[k]) for k in
                      ("idx_low", "idx_high", "shift", "shift_high")]
+        from ..obs import roofline
+
+        roof = roofline.begin()
         with budget_bucket("search/fused"):
-            packed = np.asarray(kernel_fn(
-                data, jnp.asarray(idx_map), jnp.asarray(offsets_rs),
-                jnp.asarray(cert_params), jnp.int32(roll_k), *flat))
+            # operand conversions stay inside the bucket (attributed)
+            fused_args = (data, jnp.asarray(idx_map),
+                          jnp.asarray(offsets_rs), jnp.asarray(cert_params),
+                          jnp.int32(roll_k), *flat)
+            packed = np.asarray(kernel_fn(*fused_args))
             budget_count("dispatches")
             budget_count("readbacks")
+        roofline.end(roof, "sharded_fused_hybrid", kernel_fn, fused_args)
         (coarse, sel, seed_scores, n_seed, sel2, need_scores,
          n_need) = unpack_fused_hybrid(packed, ndm, bucket, bucket2)
         maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]
@@ -715,11 +721,18 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         # state the unfused path would reach.  A need stage that fit its
         # bucket likewise completes round 1; an overflowed stage is
         # discarded — the loop recomputes the full round itself.
-        _apply(sel, fused_scores_to_host(seed_scores, roll_k, nsamples))
+        # roll_k=0 HERE: unlike the single-device fused kernel (which
+        # scores the rebased plane and leaves the peak correction to
+        # this unpack), the mesh kernel un-rotates in-kernel
+        # (jnp.roll(dedisp, -roll_k) on the pallas rescore branch) to
+        # stay bit-for-bit with the unfused sharded sweep — its peaks
+        # arrive already in true coordinates, and subtracting roll_k
+        # again would shift every seed/need arrival time on TPU meshes
+        # (code-review r7)
+        _apply(sel, fused_scores_to_host(seed_scores, 0, nsamples))
         seed_done = True
         if 0 < n_need <= bucket2:
-            _apply(sel2, fused_scores_to_host(need_scores, roll_k,
-                                              nsamples))
+            _apply(sel2, fused_scores_to_host(need_scores, 0, nsamples))
 
     certified, rho_cert_min = hybrid_certificate_gate(
         cert_scores, coarse_snrs, snrs, exact, rescore, nchan=nchan,

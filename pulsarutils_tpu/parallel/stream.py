@@ -130,6 +130,18 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     """
     import contextlib
 
+    from ..obs import metrics as _metrics
+    from ..obs.trace import set_track, span
+
+    @contextlib.contextmanager
+    def traced_chunk(istart):
+        # budget-less analogue of BudgetAccountant.chunk's tracing: the
+        # chunk span AND its nested spans (search, kernel buckets) land
+        # on this chunk's own Perfetto track
+        with set_track(f"chunk {istart}"):
+            with span("chunk", chunk=istart):
+                yield
+
     if budget is not None:
         budget.begin_stream()
 
@@ -161,16 +173,21 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     results = []
     hits = []
     for istart, chunk in chunks:
+        # with a budget, the chunk/search spans come from the accountant
+        # itself (one timing primitive); without one, emit them directly
+        # so a trace-only stream still renders per-chunk tracks
         ctx = (budget.chunk(istart) if budget is not None
-               else contextlib.nullcontext())
+               else traced_chunk(istart))
         with ctx:
             with (budget.bucket("search") if budget is not None
-                  else contextlib.nullcontext()):
+                  else span("search")):
                 table = run_one(chunk)
             results.append((istart, table))
             best = table.best_row()
+            _metrics.counter("putpu_stream_chunks_total").inc()
             if best["snr"] > snr_threshold:
                 hits.append((istart, table, best))
+                _metrics.counter("putpu_stream_hits_total").inc()
     return results, hits
 
 

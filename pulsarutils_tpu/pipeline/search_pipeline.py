@@ -28,6 +28,10 @@ import numpy as np
 
 from ..io.candidates import CandidateStore, config_fingerprint
 from ..io.sigproc import FilterbankReader
+from ..obs import memory as obs_memory
+from ..obs import metrics as obs_metrics
+from ..obs import roofline
+from ..obs.trace import begin_span
 from ..ops.clean_ops import (fft_zap_time, renormalize_data, zero_dm_filter)
 from ..ops.rebin import quick_resample
 from ..ops.search import dedispersion_search
@@ -485,8 +489,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         try:
             import jax
 
-            buf = jax.device_put(read_future.result())
+            host = read_future.result()
+            buf = jax.device_put(host)
             timer.count("prefetch_uploads")
+            obs_metrics.counter("putpu_bytes_uploaded_total").inc(
+                int(getattr(host, "nbytes", 0)))
             return buf
         except Exception:
             return None
@@ -505,12 +512,17 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             store.save_candidate(root, istart_, iend_, *payload)
         store.mark_done(istart_)
 
-    def _persist_async(payload, istart_, iend_):
+    def _persist_async(payload, istart_, iend_, pspan=None):
         t0 = time.perf_counter()
         try:
             _persist_and_mark(payload, istart_, iend_)
         finally:
             timer.add_async("persist", time.perf_counter() - t0)
+            if pspan is not None:
+                # async completion: submitted on the main thread inside
+                # the chunk, finished here on the worker — the trace
+                # shows the overlap the serial budget deliberately omits
+                pspan.end()
 
     def _drain_persist(block=False):
         # serial semantics: a failed save must fail the run — the
@@ -538,8 +550,13 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     try:
                         import jax as _jax
 
-                        src = (array_dev if array_dev is not None
-                               else _jax.device_put(array))
+                        if array_dev is None:
+                            src = _jax.device_put(array)
+                            obs_metrics.counter(
+                                "putpu_bytes_uploaded_total").inc(
+                                int(getattr(array, "nbytes", 0)))
+                        else:
+                            src = array_dev
                         # force the async host->device transfer HERE so
                         # link time has its own bucket: un-forced, the
                         # wait surfaces inside whatever device op blocks
@@ -555,6 +572,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             with with_timer("clean"):
                 if device_clean is not None:
                     try:
+                        roof = roofline.begin()
                         cleaned = device_clean(src, mask_dev)
                         timer.count("dispatches")
                         # force: dispatch is async, so a device failure
@@ -567,6 +585,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         # poisoned device array.
                         np.asarray(cleaned[0, :1])
                         timer.count("readbacks")
+                        roofline.end(roof, "device_clean", device_clean,
+                                     (src, mask_dev))
                         array = cleaned
                     except Exception as exc:
                         logger.warning("device clean failed (%r); cleaning "
@@ -620,6 +640,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         "ops.certify.cert_slack_for_miss_p)",
                         table.meta.get("cert_miss_p_at_floor", float("nan")))
                 ncertified += 1
+                obs_metrics.counter("putpu_certified_chunks_total").inc()
 
             if period_search and plane is not None:
                 from ..ops.periodicity import period_search_plane
@@ -685,8 +706,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         info.allprofs = np.asarray(info.allprofs)
                     if n_rb:
                         timer.count("readbacks", int(n_rb))
+                    obs_metrics.counter("putpu_bytes_readback_total").inc(
+                        int(np.asarray(info.allprofs).nbytes))
                 info.compute_stats()
                 hits.append((istart, iend, info, table))
+                obs_metrics.counter("putpu_hits_total").inc()
                 logger.info("HIT chunk %d-%d: DM=%.2f snr=%.2f width=%gs",
                             istart, iend, info.dm, info.snr, info.width)
 
@@ -709,8 +733,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # like the serial loop (code-review r6)
             payload = (info, table) if is_hit else None
             if persist_pool is not None:
+                pspan = begin_span("persist", track="persist-worker",
+                                   chunk=istart)
                 persist_futures.append(persist_pool.submit(
-                    _persist_async, payload, istart, iend))
+                    _persist_async, payload, istart, iend, pspan))
                 # backpressure: each queued payload retains its cutout +
                 # table on the host, so an unbounded backlog on a
                 # hit-dense stream would grow without limit (the serial
@@ -728,6 +754,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # pre-search one found the read still in flight
             if array_dev is None:
                 array_dev = prefetch_upload(next_read)
+            if fallback_state.get("backend", backend) == "jax":
+                # per-chunk device-memory watermark: HBM headroom is a
+                # tracked gauge, not an OOM surprise (obs.memory)
+                obs_memory.record_watermark()
             nproc += 1
             if progress and nproc % 50 == 0:
                 logger.info("processed %d chunks (through sample %d/%d)",

@@ -15,9 +15,20 @@ data, so no device work is warranted.
 
 from __future__ import annotations
 
+import json
+
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..utils.logging_utils import logger
+
 __all__ = ["sift_hits", "sift_candidates", "hit_fields"]
+
+#: histogram edges for candidate-quality telemetry: S/N follows the
+#: detection-floor decades (6 is the reference criterion), DM covers the
+#: plausible galactic-to-FRB range in coarse decades
+SNR_EDGES = (6.0, 7.0, 8.0, 10.0, 12.0, 15.0, 20.0, 30.0, 50.0, 100.0)
+DM_EDGES = (50.0, 100.0, 200.0, 300.0, 400.0, 600.0, 800.0, 1200.0, 2000.0)
 
 
 def hit_fields(istart, iend, info, table):
@@ -56,7 +67,7 @@ def hit_fields(istart, iend, info, table):
     }
 
 
-def sift_candidates(cands, time_radius, dm_radius=None):
+def sift_candidates(cands, time_radius, dm_radius=None, stats=None):
     """Group candidate dicts (keys ``time, dm, snr``) and keep each group's
     best.
 
@@ -78,9 +89,28 @@ def sift_candidates(cands, time_radius, dm_radius=None):
     every low-DM group.  Returns the kept candidates (descending S/N),
     each annotated with ``n_members`` — the number of raw detections it
     absorbed.
+
+    ``stats`` (round 7, candidate-quality telemetry): a mutable dict the
+    sift fills with ``in`` / ``kept`` and a per-reason breakdown of the
+    absorbed duplicates under ``rejected``:
+
+    * ``width`` — (pair-width mode only) absorbed because the
+      width-scaled time radius stretched past the 0.5 s floor
+      (wide-boxcar quantisation); with a plain numeric ``time_radius``
+      no width-derived radius exists, so this reason never fires;
+    * ``dm_radius`` — time matched but the DM offset exceeded 1 and
+      needed the DM-proportional radius (chunk-to-chunk DM jitter);
+    * ``duplicate`` — everything else: time and DM both matched
+      trivially (the textbook chunk-overlap / trial-neighbour
+      duplicate).
     """
     pair_width = time_radius == "pair-width"
     order = sorted(range(len(cands)), key=lambda i: -cands[i]["snr"])
+    if stats is None:
+        stats = {}
+    stats["in"] = len(cands)
+    rejected = stats.setdefault(
+        "rejected", {"duplicate": 0, "width": 0, "dm_radius": 0})
     kept = []
     for i in order:
         c = cands[i]
@@ -92,12 +122,19 @@ def sift_candidates(cands, time_radius, dm_radius=None):
                 t_radius = time_radius
             k_radius = (0.02 * k["dm"] + 1.0 if dm_radius is None
                         else dm_radius)
-            if (abs(c["time"] - k["time"]) <= t_radius
-                    and abs(c["dm"] - k["dm"]) <= k_radius):
+            dt = abs(c["time"] - k["time"])
+            ddm = abs(c["dm"] - k["dm"])
+            if dt <= t_radius and ddm <= k_radius:
                 k["n_members"] += 1
+                # the 0.5 s floor is a pair-width-mode concept: only
+                # there can "needed the width-scaled radius" be blamed
+                reason = ("width" if pair_width and dt > 0.5
+                          else "dm_radius" if ddm > 1.0 else "duplicate")
+                rejected[reason] += 1
                 break
         else:
             kept.append({**c, "n_members": 1})
+    stats["kept"] = len(kept)
     return kept
 
 
@@ -131,6 +168,14 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
 
     Returns a list of candidate dicts (descending S/N) with keys
     ``time, dm, snr, width, istart, iend, n_members, info, table``.
+
+    Telemetry (round 7): the in/kept totals and the per-reason rejected
+    counts land in the metrics registry
+    (``putpu_sift_candidates_in_total`` / ``..._kept_total`` /
+    ``putpu_sift_rejected_total{reason=...}``), kept candidates feed the
+    ``putpu_sift_snr`` / ``putpu_sift_dm`` histograms, and one
+    ``SIFT_JSON {...}`` footer line is logged for artifact parsers —
+    the sift counterpart of the stream's ``BUDGET_JSON`` footer.
     """
     if not hits:
         return []
@@ -140,4 +185,16 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
             time_radius = 1.5 * max(c["span"] for c in cands)
         else:
             time_radius = "pair-width"
-    return sift_candidates(cands, time_radius, dm_radius)
+    stats = {}
+    kept = sift_candidates(cands, time_radius, dm_radius, stats=stats)
+    _metrics.counter("putpu_sift_candidates_in_total").inc(stats["in"])
+    _metrics.counter("putpu_sift_candidates_kept_total").inc(stats["kept"])
+    for reason, n in stats["rejected"].items():
+        _metrics.counter("putpu_sift_rejected_total", reason=reason).inc(n)
+    snr_hist = _metrics.histogram("putpu_sift_snr", edges=SNR_EDGES)
+    dm_hist = _metrics.histogram("putpu_sift_dm", edges=DM_EDGES)
+    for c in kept:
+        snr_hist.observe(c["snr"])
+        dm_hist.observe(c["dm"])
+    logger.info("SIFT_JSON %s", json.dumps(stats))
+    return kept

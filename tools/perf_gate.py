@@ -1,0 +1,139 @@
+"""Perf-regression gate: fail when bench numbers regress past tolerance.
+
+Compares a fresh ``bench_suite.py --metrics-out`` snapshot against a
+committed baseline (direction-aware per-config headline values — see
+:mod:`pulsarutils_tpu.obs.gate`) and exits nonzero on any regression,
+so the BENCH trajectory is *enforced* per PR, not just recorded.
+
+One-line CPU invocation (the committed ``BENCH_GATE_cpu.jsonl`` baseline,
+quick preset, the two fast configs — also wired as a ``slow``-marked
+test in ``tests/test_obs.py``):
+
+    JAX_PLATFORMS=cpu python tools/perf_gate.py
+
+Against a snapshot you already have (no benches run):
+
+    python tools/perf_gate.py --snapshot fresh.jsonl
+
+Against a full-preset baseline, pass the committed artifact and the
+configs it covers — any config that emits a value record works (config
+2 defers to ``bench.py`` and emits none, so it cannot be gated), e.g.::
+
+    python tools/perf_gate.py --baseline BENCH_GATE_tpu.jsonl \
+        --configs 1 6 7 --preset full
+
+Exit codes: 0 = within tolerance, 1 = regression/missing/errored
+config, 2 = usage/baseline problems.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pulsarutils_tpu.obs import gate  # noqa: E402
+
+#: default baseline + configs: the CPU quick-preset snapshot committed
+#: with the repo (configs 1 and 7: the NumPy reference sweep and the
+#: instrumented streaming budget — both run in tier-1-scale time on CPU)
+DEFAULT_BASELINE = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
+DEFAULT_CONFIGS = (1, 7)
+
+
+def run_suite(configs, preset, out_path):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if preset:
+        env["BENCH_PRESET"] = preset
+    cmd = [sys.executable, os.path.join(REPO, "bench_suite.py"),
+           "--configs", *[str(c) for c in configs],
+           "--metrics-out", out_path]
+    print(f"perf_gate: running {' '.join(cmd)} "
+          f"(JAX_PLATFORMS={env['JAX_PLATFORMS']}, "
+          f"BENCH_PRESET={env.get('BENCH_PRESET', 'full')})",
+          file=sys.stderr, flush=True)
+    subprocess.run(cmd, env=env, cwd=REPO, check=True)
+
+
+def parse_tol(items):
+    out = {}
+    for item in items or ():
+        cfg, _, tol = item.partition("=")
+        if not tol:
+            raise SystemExit(f"--tol {item!r}: expected CONFIG=REL_TOL")
+        out[int(cfg)] = float(tol)
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="compare a fresh bench snapshot against a committed "
+                    "baseline; exit 1 on regression")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="committed snapshot (JSON lines with "
+                             "config/value records); default "
+                             "BENCH_GATE_cpu.jsonl")
+    parser.add_argument("--snapshot", default=None,
+                        help="pre-captured fresh snapshot; when omitted "
+                             "the suite is run (--configs, --preset)")
+    parser.add_argument("--configs", type=int, nargs="*",
+                        default=list(DEFAULT_CONFIGS),
+                        help="configs to run/compare (default: 1 7)")
+    parser.add_argument("--preset", default="quick",
+                        choices=("quick", "full"),
+                        help="BENCH_PRESET when running the suite "
+                             "(default quick; must match the baseline's)")
+    parser.add_argument("--tolerance", type=float,
+                        default=gate.DEFAULT_REL_TOL,
+                        help="default relative tolerance (default "
+                             f"{gate.DEFAULT_REL_TOL})")
+    parser.add_argument("--tol", action="append", metavar="CONFIG=REL",
+                        help="per-config tolerance override, repeatable "
+                             "(e.g. --tol 7=0.8)")
+    opts = parser.parse_args(argv)
+
+    if not os.path.exists(opts.baseline):
+        print(f"perf_gate: baseline {opts.baseline} not found "
+              "(generate one: bench_suite.py --metrics-out <path> under "
+              "the same platform/preset, then commit it)",
+              file=sys.stderr)
+        return 2
+    baseline = gate.load_snapshot(opts.baseline)
+
+    if opts.snapshot:
+        fresh = gate.load_snapshot(opts.snapshot)
+    else:
+        fd, fresh_path = tempfile.mkstemp(suffix=".jsonl",
+                                          prefix="perf_gate_")
+        os.close(fd)
+        try:
+            run_suite(opts.configs, opts.preset, fresh_path)
+            fresh = gate.load_snapshot(fresh_path)
+        except subprocess.CalledProcessError as exc:
+            print(f"perf_gate: bench suite failed: {exc}", file=sys.stderr)
+            return 1
+        finally:
+            try:
+                os.unlink(fresh_path)
+            except OSError:
+                pass
+
+    ok, rows = gate.compare(baseline, fresh, rel_tol=opts.tolerance,
+                            per_config_tol=parse_tol(opts.tol),
+                            configs=opts.configs)
+    print(gate.format_report(rows))
+    if ok:
+        print("perf_gate: PASS")
+        return 0
+    print("perf_gate: FAIL (regression or missing config — see rows "
+          "above; committed baselines live at BENCH_GATE_*.jsonl)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
