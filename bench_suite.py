@@ -17,6 +17,9 @@ all). Failures in one config don't stop the others.
      MULTICHIP_r06-style record with per-route dispatch/readback
      counters — one fused shard_map program per hit chunk vs coarse +
      one dispatch per rescore bucket
+  9  chaos drill (tools/chaos_drill.py): the full survey loop under the
+     fault matrix — recoverable classes byte-identical to the
+     fault-free run, unrecoverable classes quarantined + audited
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -476,10 +479,33 @@ def config8(quick):
           "ab": result})
 
 
+def config9(quick):
+    """Chaos drill (ISSUE 4): the streaming survey under the fault
+    matrix.  The emitted value is the number of fault classes survived
+    (recoverable classes must reproduce the fault-free candidates +
+    ledger byte-identically; unrecoverable classes must complete with
+    the affected chunks quarantined and the integrity audit clean) —
+    a drop is a robustness regression, gated like any perf number.
+    """
+    drill = _load_tool("chaos_drill")
+
+    result = drill.run_drill(quick=quick, log=log)
+    emit({"config": 9, "metric": "chaos drill: "
+          f"{result['n_classes']} fault classes over a "
+          f"{len(result['survey']['chunks'])}-chunk survey",
+          "value": result["recovered_identical"] + result["contained"],
+          "unit": "fault classes survived",
+          "all_ok": result["all_ok"],
+          "recovered_identical": result["recovered_identical"],
+          "contained": result["contained"],
+          "wall_s": result["wall_s"],
+          "classes": {k: v["ok"] for k, v in result["classes"].items()}})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
-                        default=[1, 2, 3, 4, 5, 6, 7, 8])
+                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -496,7 +522,7 @@ def main(argv=None):
     except Exception:
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8}
+           6: config6, 7: config7, 8: config8, 9: config9}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

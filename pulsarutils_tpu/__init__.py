@@ -141,6 +141,11 @@ _LAZY = {
     # streaming wall-clock budget accountant (round 6)
     "BudgetAccountant": ("utils.logging_utils", "BudgetAccountant"),
     "measure_device_rtt": ("utils.logging_utils", "measure_device_rtt"),
+    # fault injection + failure policy (ISSUE 4)
+    "FaultPlan": ("faults.inject", "FaultPlan"),
+    "FaultSpec": ("faults.inject", "FaultSpec"),
+    "IntegrityPolicy": ("faults.policy", "IntegrityPolicy"),
+    "audit_run": ("faults.audit", "audit_run"),
 }
 
 

@@ -73,6 +73,30 @@ def build_parser():
                         default="hits")
     parser.add_argument("--no-resume", action="store_true",
                         help="reprocess chunks already in the ledger")
+    parser.add_argument("--dispatch-timeout", type=float, default=None,
+                        metavar="SECONDS",
+                        help="deadline per device dispatch (watchdog "
+                             "thread): a wedged device no longer stalls "
+                             "the stream forever — the chunk proceeds to "
+                             "retry/numpy fallback within timeout x "
+                             "(retries+1).  Default off.  CAUTION: the "
+                             "watchdog dispatches from a non-main "
+                             "thread; device clients that require "
+                             "main-thread dispatch (some tunnelled "
+                             "setups) must be tested before enabling — "
+                             "see docs/robustness.md")
+    parser.add_argument("--dispatch-retries", type=int, default=1,
+                        help="same-backend retries before the numpy "
+                             "fallback (default 1, the pre-hardening "
+                             "behaviour)")
+    parser.add_argument("--quarantine-policy", default="sanitize",
+                        choices=("sanitize", "strict", "off"),
+                        help="pre-search data-integrity gate: 'sanitize' "
+                             "(default) imputes sub-threshold NaN/Inf and "
+                             "quarantines unrecoverable chunks into "
+                             "quarantine_<fingerprint>.jsonl; 'strict' "
+                             "quarantines any non-finite chunk; 'off' "
+                             "disables the gate")
     parser.add_argument("--max-chunks", type=int, default=None)
     parser.add_argument("--period-search", action="store_true",
                         help="also run the folded period search on each "
@@ -152,6 +176,9 @@ def main(args=None):
             max_chunks=opts.max_chunks,
             period_search=opts.period_search,
             period_sigma_threshold=opts.period_sigma,
+            dispatch_timeout=opts.dispatch_timeout,
+            dispatch_retries=opts.dispatch_retries,
+            quarantine_policy=opts.quarantine_policy,
         )
         total_raw += len(hits)
         if hits and not opts.no_sift:

@@ -1,0 +1,48 @@
+"""Fault injection + failure policy: the survey loop's immune system.
+
+The reference package has no failure handling at all (SURVEY §5), yet on
+real telescopes and preemptible TPU fleets wedged devices, truncated
+filterbank files, RFI-saturated/NaN chunks and disk hiccups are the
+steady state — real-time pipelines treat dropped/corrupt blocks as
+routine input, not exceptions.  Three pillars (ISSUE 4):
+
+* :mod:`.inject` — a seeded, composable :class:`~.inject.FaultPlan`
+  that injects failures at every seam (reader I/O, data corruption,
+  device dispatch, persist writes, the mesh route), armed via context
+  manager or the ``PUTPU_FAULT_PLAN`` env var.  With no plan armed the
+  production code path is byte-identical — every hook is a single
+  module-global ``None`` check;
+* :mod:`.policy` — the hardening the injection forces: deadline-wrapped
+  device dispatch (:func:`~.policy.call_with_deadline`), the pre-search
+  data-integrity gate (:func:`~.policy.gate_chunk`: sanitize
+  recoverable chunks, quarantine unrecoverable ones into a
+  ``quarantine_<fingerprint>.jsonl`` manifest), and bounded persist
+  retry with dead-letter records;
+* :mod:`.audit` — the end-of-run integrity audit cross-checking ledger
+  entries vs candidate files vs the quarantine manifest.
+
+``tools/chaos_drill.py`` is the proof: the full streaming survey under
+a fault matrix, with recoverable runs asserted byte-identical to the
+fault-free run.  Everything here is numpy+stdlib only and safe to
+import before a JAX backend exists.
+"""
+
+from .inject import FaultPlan, FaultSpec, active, arm, disarm
+from .policy import (DispatchPolicy, DispatchTimeoutError, IntegrityPolicy,
+                     QuarantineManifest, call_with_deadline, gate_chunk,
+                     resolve_integrity_policy)
+
+__all__ = [
+    "DispatchPolicy",
+    "DispatchTimeoutError",
+    "FaultPlan",
+    "FaultSpec",
+    "IntegrityPolicy",
+    "QuarantineManifest",
+    "active",
+    "arm",
+    "call_with_deadline",
+    "disarm",
+    "gate_chunk",
+    "resolve_integrity_policy",
+]

@@ -1,0 +1,305 @@
+"""Seeded, composable fault injection for the streaming survey loop.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` records, each
+naming a **site** (the seam it fires at), a failure **kind**, the chunk
+starts it applies to and a firing budget (``times``).  The instrumented
+code calls the module-level hooks (:func:`fire`, :func:`corrupt`,
+:func:`truncated_length`); with no plan armed every hook is one
+module-global ``None`` check and the production path is byte-identical.
+
+Sites and the seams they instrument:
+
+========== ==================================================== ==========================
+site       seam                                                 kinds
+========== ==================================================== ==========================
+``read``   ``FilterbankReader.read_block(_packed)``             ``error``, ``truncate``
+``corrupt``the streaming driver's reader thread (post-decode)   ``nan``, ``inf``,
+                                                                ``dead_channels``,
+                                                                ``zero_run``, ``saturate``
+``dispatch``the per-chunk device search dispatch                ``error``, ``hang``
+``mesh``   the sharded multi-device route inside the dispatch   ``error``, ``hang``
+``persist````CandidateStore.save_candidate``                    ``error``
+========== ==================================================== ==========================
+
+Arming: ``with plan.armed(): ...`` (tests, the chaos drill), or export
+``PUTPU_FAULT_PLAN`` with the plan's JSON — the env form survives a
+subprocess boundary, so a CLI survey run can be chaos-tested unchanged.
+Every firing is counted per spec (for assertions) and mirrored into the
+metrics registry as ``putpu_faults_injected_total{site=...}``.
+
+Corruption is deterministic: the rng is seeded from ``(spec.seed,
+chunk)``, so the same plan over the same file corrupts the same values.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+
+#: the process-wide armed plan (None = injection off).  A bare module
+#: global on purpose: the hooks sit on per-chunk hot paths and must cost
+#: one LOAD_GLOBAL when disarmed.
+_ACTIVE = None
+_ENV_CHECKED = False
+#: suppression depth: hooks no-op while > 0 (see :func:`suppressed`)
+_SUPPRESS = 0
+
+#: exception classes a spec may raise by name (kept to safe, relevant
+#: types — the env var must not become an arbitrary-class loader)
+_EXC_TYPES = {
+    "OSError": OSError,
+    "IOError": OSError,
+    "RuntimeError": RuntimeError,
+    "MemoryError": MemoryError,
+}
+
+#: default exception class per site when the spec names none
+_SITE_DEFAULT_EXC = {"read": "OSError", "persist": "OSError"}
+
+_CORRUPT_KINDS = ("nan", "inf", "dead_channels", "zero_run", "saturate")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One injectable failure.  ``chunks=None`` matches every chunk;
+    ``times=None`` never exhausts (a *persistent* fault — e.g. the dead
+    mesh of the sticky-fallback test), ``times=1`` is a transient."""
+
+    site: str
+    kind: str = "error"
+    chunks: tuple | None = None     # chunk istarts; None = all
+    times: int | None = 1           # firing budget; None = unlimited
+    frac: float = 0.01              # corruption fraction
+    seconds: float = 60.0           # hang duration
+    seed: int = 0                   # corruption rng seed (mixed w/ chunk)
+    exc: str | None = None          # exception class name for kind=error
+    fired: int = dataclasses.field(default=0, init=False)
+
+    def matches(self, site, chunk):
+        if site != self.site:
+            return False
+        if self.chunks is not None and chunk is not None \
+                and int(chunk) not in {int(c) for c in self.chunks}:
+            return False
+        return True
+
+    def to_json(self):
+        d = {"site": self.site, "kind": self.kind, "times": self.times,
+             "frac": self.frac, "seconds": self.seconds, "seed": self.seed}
+        if self.chunks is not None:
+            d["chunks"] = [int(c) for c in self.chunks]
+        if self.exc is not None:
+            d["exc"] = self.exc
+        return d
+
+
+class FaultPlan:
+    """A composable set of :class:`FaultSpec` with thread-safe firing
+    budgets (hooks fire from the reader thread, the persist worker and
+    the main loop concurrently)."""
+
+    def __init__(self, specs=()):
+        self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
+                      for s in specs]
+        self._lock = threading.Lock()
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _claim(self, spec):
+        """Atomically consume one firing from ``spec``'s budget."""
+        with self._lock:
+            if spec.times is not None and spec.fired >= spec.times:
+                return False
+            spec.fired += 1
+        _metrics.counter("putpu_faults_injected_total",
+                         site=spec.site).inc()
+        return True
+
+    def fired(self, site=None):
+        """Total firings, optionally restricted to one site."""
+        with self._lock:
+            return sum(s.fired for s in self.specs
+                       if site is None or s.site == site)
+
+    # -- hooks (called via the module-level wrappers) ------------------------
+
+    def fire(self, site, chunk=None, **ctx):
+        """Raise / hang for matching ``error``/``hang`` specs."""
+        for spec in self.specs:
+            if spec.kind not in ("error", "hang") \
+                    or not spec.matches(site, chunk):
+                continue
+            if not self._claim(spec):
+                continue
+            if spec.kind == "hang":
+                time.sleep(spec.seconds)
+                continue
+            exc_name = spec.exc or _SITE_DEFAULT_EXC.get(site,
+                                                         "RuntimeError")
+            exc_cls = _EXC_TYPES.get(exc_name, RuntimeError)
+            raise exc_cls(f"FAULTPLAN: injected {site} {spec.kind} "
+                          f"(chunk={chunk})")
+
+    def truncated_length(self, site, chunk, n):
+        """Shortened read length for matching ``truncate`` specs."""
+        for spec in self.specs:
+            if spec.kind == "truncate" and spec.matches(site, chunk) \
+                    and self._claim(spec):
+                n = max(int(n * (1.0 - spec.frac)), 1)
+        return n
+
+    def corrupt(self, site, block, chunk=None):
+        """Apply matching corruption kinds to a copy of ``block``."""
+        out = None
+        for spec in self.specs:
+            if spec.kind not in _CORRUPT_KINDS \
+                    or not spec.matches(site, chunk):
+                continue
+            if not self._claim(spec):
+                continue
+            if out is None:
+                # preserve the block's floating dtype: a float64 copy of
+                # a float32 survey chunk would retrace the jitted clean/
+                # search for a signature production never runs (ints
+                # promote to float32 so nan/inf kinds are expressible)
+                src = np.asarray(block)
+                dtype = (src.dtype if np.issubdtype(src.dtype, np.floating)
+                         else np.float32)
+                out = np.array(src, dtype=dtype, copy=True)
+            rng = np.random.default_rng(
+                (int(spec.seed), 0 if chunk is None else int(chunk)))
+            nchan, nsamp = out.shape
+            if spec.kind in ("nan", "inf"):
+                k = max(int(out.size * spec.frac), 1)
+                idx = rng.choice(out.size, size=k, replace=False)
+                val = np.nan if spec.kind == "nan" else np.inf
+                # .flat, not .ravel(): a transposed (F-ordered) block's
+                # ravel() is a copy and the assignment would be lost
+                out.flat[idx] = val
+            elif spec.kind == "dead_channels":
+                k = max(int(nchan * spec.frac), 1)
+                out[rng.choice(nchan, size=k, replace=False)] = 0.0
+            elif spec.kind == "zero_run":
+                # dropped packets: a contiguous run of zeroed frames
+                k = max(int(nsamp * spec.frac), 1)
+                lo = int(rng.integers(0, max(nsamp - k, 1)))
+                out[:, lo:lo + k] = 0.0
+            elif spec.kind == "saturate":
+                # clipped digitiser: everything above the (1-frac)
+                # quantile collapses onto one rail value.  nan-aware:
+                # composed after a nan/inf spec on the same chunk, the
+                # plain quantile/max would be NaN and saturation a
+                # silent no-op (code-review r8)
+                v = np.nanquantile(np.where(np.isinf(out), np.nan, out),
+                                   1.0 - spec.frac)
+                if np.isfinite(v):
+                    out[out >= v] = float(v)
+        return block if out is None else out
+
+    # -- arming --------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def armed(self):
+        """Arm this plan process-wide for the block (restores any
+        previously armed plan on exit)."""
+        global _ACTIVE
+        prev = _ACTIVE
+        _ACTIVE = self
+        try:
+            yield self
+        finally:
+            _ACTIVE = prev
+
+    # -- (de)serialisation ---------------------------------------------------
+
+    def to_json(self):
+        return json.dumps({"specs": [s.to_json() for s in self.specs]})
+
+    @classmethod
+    def from_json(cls, blob):
+        data = json.loads(blob) if isinstance(blob, str) else blob
+        specs = data["specs"] if isinstance(data, dict) else data
+        out = []
+        for d in specs:
+            d = dict(d)
+            if d.get("chunks") is not None:
+                d["chunks"] = tuple(d["chunks"])
+            out.append(FaultSpec(**d))
+        return cls(out)
+
+
+@contextlib.contextmanager
+def suppressed():
+    """Temporarily disable every hook inside the block.
+
+    For code that shares an instrumented seam but has its own
+    resilience story and is NOT the chunk loop under test — e.g. the
+    bad-channel pre-scan streams the whole file through the same
+    ``read_block`` seam before the hardened chunk loop exists, so an
+    env-armed read fault would crash the run at startup (and silently
+    consume a ``times=1`` budget the targeted search chunk never sees).
+    """
+    global _SUPPRESS
+    _SUPPRESS += 1
+    try:
+        yield
+    finally:
+        _SUPPRESS -= 1
+
+
+def arm(plan):
+    """Arm ``plan`` process-wide (prefer ``plan.armed()`` in tests)."""
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def disarm():
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active():
+    """The armed plan, or ``None``.  The first call honours the
+    ``PUTPU_FAULT_PLAN`` env var (the plan's JSON) so a subprocess CLI
+    run can be chaos-tested without code changes.  The env var is read
+    ONCE and the result latched (the hooks sit on hot paths): set it
+    before the process starts; to arm a plan mid-process use
+    :func:`arm` / ``plan.armed()``."""
+    global _ACTIVE, _ENV_CHECKED
+    if _ACTIVE is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        blob = os.environ.get("PUTPU_FAULT_PLAN")
+        if blob:
+            _ACTIVE = FaultPlan.from_json(blob)
+    return _ACTIVE
+
+
+# -- hot-path hooks (one None check when disarmed) ---------------------------
+
+def fire(site, chunk=None, **ctx):
+    plan = _ACTIVE if _ACTIVE is not None or _ENV_CHECKED else active()
+    if plan is not None and not _SUPPRESS:
+        plan.fire(site, chunk=chunk, **ctx)
+
+
+def corrupt(site, block, chunk=None):
+    plan = _ACTIVE if _ACTIVE is not None or _ENV_CHECKED else active()
+    if plan is None or _SUPPRESS:
+        return block
+    return plan.corrupt(site, block, chunk=chunk)
+
+
+def truncated_length(site, chunk, n):
+    plan = _ACTIVE if _ACTIVE is not None or _ENV_CHECKED else active()
+    if plan is None or _SUPPRESS:
+        return n
+    return plan.truncated_length(site, chunk, n)

@@ -17,10 +17,14 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 
+from ..faults import inject as fault_inject
 from ..pipeline.pulse_info import PulseInfo
 from ..utils.table import ResultTable
+
+logger = logging.getLogger("pulsarutils_tpu")
 
 
 def config_fingerprint(**kwargs):
@@ -50,9 +54,39 @@ class CandidateStore:
             self._ledger = self._load_ledger()
 
     def _load_ledger(self):
+        """Load the ledger, surviving a torn/corrupt file.
+
+        ``mark_done`` writes atomically (tmp + rename), but the file can
+        still arrive torn — a crash mid-``os.replace`` on some
+        filesystems, a partial rsync, disk corruption.  A corrupt ledger
+        used to raise ``json.JSONDecodeError`` and kill resume entirely;
+        now the bad file is backed up to ``<ledger>.corrupt`` and a
+        fresh ledger starts (worst case: already-done chunks are
+        re-searched, which resume semantics make idempotent).
+
+        Only parse/shape failures (``ValueError``) mean corruption: a
+        transient ``OSError`` on an intact file must propagate, not
+        trash hours of resume progress (code-review r8).
+        """
         if os.path.exists(self._ledger_path):
-            with open(self._ledger_path) as f:
-                return json.load(f)
+            try:
+                with open(self._ledger_path) as f:
+                    ledger = json.load(f)
+                if not isinstance(ledger, dict) \
+                        or not isinstance(ledger.get("done"), list):
+                    raise ValueError("ledger is not a {fingerprint, done} "
+                                     "record")
+                return ledger
+            except ValueError as exc:
+                backup = self._ledger_path + ".corrupt"
+                try:
+                    os.replace(self._ledger_path, backup)
+                except OSError:
+                    backup = "<unremovable>"
+                logger.warning(
+                    "torn/corrupt resume ledger %s (%r): backed up to %s, "
+                    "starting a fresh ledger (done chunks will be "
+                    "re-searched)", self._ledger_path, exc, backup)
         return {"fingerprint": self.fingerprint, "done": []}
 
     # -- resume ledger -------------------------------------------------------
@@ -62,11 +96,24 @@ class CandidateStore:
             return False
         return istart in self._ledger["done"]
 
-    def mark_done(self, istart):
+    def mark_done(self, istart, reason=None):
+        """Record a chunk as processed.  ``reason`` marks a chunk done
+        **with a reason** — quarantined or persist-dead-lettered: it is
+        never re-searched on resume (exact resume semantics), and the
+        reason survives in the ledger for the integrity audit.  The
+        ``quarantined`` key only appears when a reason was recorded, so
+        a clean run's ledger stays byte-identical to pre-hardening."""
         if self.fingerprint is None:
             return
-        if istart not in self._ledger["done"]:
-            self._ledger["done"].append(istart)
+        quarantined = self._ledger.get("quarantined", {})
+        if istart not in self._ledger["done"] \
+                or (reason is not None
+                    and quarantined.get(str(istart)) != reason):
+            if istart not in self._ledger["done"]:
+                self._ledger["done"].append(istart)
+            if reason is not None:
+                self._ledger.setdefault(
+                    "quarantined", {})[str(istart)] = str(reason)
             tmp = self._ledger_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(self._ledger, f)
@@ -75,6 +122,11 @@ class CandidateStore:
     @property
     def done_chunks(self):
         return sorted(self._ledger["done"])
+
+    @property
+    def quarantined_chunks(self):
+        """``{str(istart): reason}`` for chunks marked done-with-reason."""
+        return dict(self._ledger.get("quarantined", {}))
 
     # -- candidates ----------------------------------------------------------
 
@@ -90,6 +142,7 @@ class CandidateStore:
 
     def save_candidate(self, root, istart, iend, info: PulseInfo,
                        table: ResultTable):
+        fault_inject.fire("persist", chunk=istart)
         base = self._base(root, istart, iend)
         self.trim_waterfall(info, table).save(base + ".info.npz")
         table.to_npz(base + ".table.npz")

@@ -29,6 +29,8 @@ import struct
 
 import numpy as np
 
+from ..faults import inject as fault_inject
+
 _INT_KEYS = {
     "machine_id", "telescope_id", "data_type", "barycentric",
     "pulsarcentric", "nbits", "nsamples", "nchans", "nifs", "nbeams",
@@ -65,15 +67,30 @@ def _pack_record(key, value):
     return rec
 
 
+def _read_exact(f, n, path, what):
+    """Read exactly ``n`` bytes or raise a clean ``ValueError`` naming
+    the byte offset and expected length (a file truncated mid-header
+    used to surface as a raw ``struct.error`` from ``struct.unpack``)."""
+    offset = f.tell()
+    data = f.read(n)
+    if len(data) != n:
+        raise ValueError(
+            f"{path}: truncated SIGPROC header — expected {n} bytes for "
+            f"{what} at byte offset {offset}, got {len(data)}")
+    return data
+
+
 def read_header(path):
     """Parse a SIGPROC header.  Returns ``(header_dict, data_offset)``."""
     header = {}
     with open(path, "rb") as f:
         def read_string():
-            (n,) = struct.unpack("<i", f.read(4))
+            (n,) = struct.unpack(
+                "<i", _read_exact(f, 4, path, "a string length"))
             if not 0 < n < 128:
                 raise ValueError(f"corrupt SIGPROC header string length {n}")
-            return f.read(n).decode("ascii")
+            return _read_exact(f, n, path,
+                               "a header string").decode("ascii")
 
         if read_string() != "HEADER_START":
             raise ValueError(f"{path}: not a SIGPROC filterbank file")
@@ -82,13 +99,16 @@ def read_header(path):
             if key == "HEADER_END":
                 break
             if key in _INT_KEYS:
-                (header[key],) = struct.unpack("<i", f.read(4))
+                (header[key],) = struct.unpack(
+                    "<i", _read_exact(f, 4, path, f"int key {key!r}"))
             elif key in _DOUBLE_KEYS:
-                (header[key],) = struct.unpack("<d", f.read(8))
+                (header[key],) = struct.unpack(
+                    "<d", _read_exact(f, 8, path, f"double key {key!r}"))
             elif key in _STR_KEYS:
                 header[key] = read_string()
             elif key in _CHAR_KEYS:
-                (header[key],) = struct.unpack("<b", f.read(1))
+                (header[key],) = struct.unpack(
+                    "<b", _read_exact(f, 1, path, f"char key {key!r}"))
             else:
                 # unknown keys cannot be skipped (their payload length is
                 # key-specific), so fail loudly with the offending name
@@ -194,7 +214,9 @@ class FilterbankReader:
 
     def read_block(self, istart, nsamps, band_ascending=False):
         istart = int(istart)
+        fault_inject.fire("read", chunk=istart)
         nsamps = int(min(nsamps, self.nsamples - istart))
+        nsamps = fault_inject.truncated_length("read", istart, nsamps)
         raw = np.asarray(self._mmap[istart:istart + nsamps])
         return self.unpack_frames(raw, band_ascending=band_ascending)
 
@@ -217,7 +239,9 @@ class FilterbankReader:
                 f"read_block_packed is single-IF only (nifs={self.nifs}); "
                 "use read_block, which honours if_mode")
         istart = int(istart)
+        fault_inject.fire("read", chunk=istart)
         nsamps = int(min(nsamps, self.nsamples - istart))
+        nsamps = fault_inject.truncated_length("read", istart, nsamps)
         return np.asarray(self._mmap[istart:istart + nsamps])
 
     def unpack_frames(self, raw, band_ascending=False):

@@ -99,7 +99,8 @@ def iter_chunk_starts(nsamples, plan, tmin=0, sample_time=None):
 def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   *, backend="jax", snr_threshold=6.0, trial_dms=None,
                   dm_block=None, chan_block=None, budget=None, mesh=None,
-                  kernel="auto"):
+                  kernel="auto", dispatch_timeout=None, dispatch_retries=0,
+                  skip_failed=False):
     """Search an iterable of ``(istart, (nchan, step))`` chunks.
 
     One compiled executable serves every distinct chunk shape; interior
@@ -127,11 +128,26 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     sharded searches exactly as single-device — and a compile observed
     on any chunk after the first is flagged as a retrace (the
     one-executable contract above is *checked*, not assumed — round 6).
+
+    Robustness (ISSUE 4 — defaults reproduce the pre-hardening path):
+    ``dispatch_timeout`` bounds each chunk's search on a watchdog
+    thread (a wedged dispatch was an infinite stall),
+    ``dispatch_retries`` re-attempts a failed/timed-out chunk, and
+    ``skip_failed=True`` drops a chunk that still fails (logged +
+    ``putpu_stream_chunks_failed_total``) instead of killing the whole
+    stream.  ValueError/TypeError always propagate, even under
+    ``skip_failed`` — they are treated as configuration errors (which
+    would fail identically on every chunk), so a producer feeding
+    malformed per-chunk arrays must validate shapes upstream rather
+    than rely on containment.
     """
     import contextlib
 
+    from ..faults import inject as fault_inject
+    from ..faults.policy import call_with_deadline
     from ..obs import metrics as _metrics
     from ..obs.trace import set_track, span
+    from ..utils.logging_utils import logger
 
     @contextlib.contextmanager
     def traced_chunk(istart):
@@ -145,7 +161,8 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     if budget is not None:
         budget.begin_stream()
 
-    def run_one(chunk):
+    def run_one(istart, chunk):
+        fault_inject.fire("dispatch", chunk=istart, backend=backend)
         if mesh is not None and backend == "jax":
             if kernel == "hybrid":
                 from .sharded_fdmt import sharded_hybrid_search
@@ -170,6 +187,24 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
             chan_block=chan_block,
             **({} if kernel == "auto" else {"kernel": kernel}))
 
+    def run_guarded(istart, chunk):
+        last = None
+        for attempt in range(max(int(dispatch_retries), 0) + 1):
+            if attempt:
+                _metrics.counter("putpu_dispatch_retries_total").inc()
+            try:
+                return call_with_deadline(lambda: run_one(istart, chunk),
+                                          dispatch_timeout)
+            except (ValueError, TypeError):
+                raise  # deterministic configuration error
+            except Exception as exc:  # jax errors share no base class
+                last = exc
+                logger.warning("stream chunk %s search failed (%r); "
+                               "%s", istart, exc,
+                               "retrying" if attempt < dispatch_retries
+                               else "giving up")
+        raise last
+
     results = []
     hits = []
     for istart, chunk in chunks:
@@ -179,9 +214,20 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
         ctx = (budget.chunk(istart) if budget is not None
                else traced_chunk(istart))
         with ctx:
-            with (budget.bucket("search") if budget is not None
-                  else span("search")):
-                table = run_one(chunk)
+            try:
+                with (budget.bucket("search") if budget is not None
+                      else span("search")):
+                    table = run_guarded(istart, chunk)
+            except (ValueError, TypeError):
+                raise
+            except Exception:
+                if not skip_failed:
+                    raise
+                # containment: one broken chunk must not kill a long
+                # stream — counted, logged above, and absent from the
+                # results (callers see exactly which chunks made it)
+                _metrics.counter("putpu_stream_chunks_failed_total").inc()
+                continue
             results.append((istart, table))
             best = table.best_row()
             _metrics.counter("putpu_stream_chunks_total").inc()

@@ -23,15 +23,21 @@ from __future__ import annotations
 import json
 import os
 import time
+import zipfile
+import zlib
 
 import numpy as np
 
+from ..faults import inject as fault_inject
+from ..faults.policy import (DispatchPolicy, QuarantineManifest,
+                             call_with_deadline, gate_chunk,
+                             resolve_integrity_policy)
 from ..io.candidates import CandidateStore, config_fingerprint
 from ..io.sigproc import FilterbankReader
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import roofline
-from ..obs.trace import begin_span
+from ..obs.trace import begin_span, span as trace_span
 from ..ops.clean_ops import (fft_zap_time, renormalize_data, zero_dm_filter)
 from ..ops.rebin import quick_resample
 from ..ops.search import dedispersion_search
@@ -44,7 +50,8 @@ from ..utils.logging_utils import (BudgetAccountant, logger,
 
 def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
                           eff_tsamp, *, backend, kernel, capture_plane,
-                          state=None, mesh=None, snr_floor=None):
+                          state=None, mesh=None, snr_floor=None,
+                          chunk=None, policy=None):
     """One chunk's search with failure containment.
 
     The reference has no failure handling at all (SURVEY §5).  Policy:
@@ -52,14 +59,24 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
     - configuration errors (ValueError/TypeError) propagate immediately —
       they are deterministic and would fail identically on every chunk;
     - a device-side failure (worker crash, wedged tunnel, OOM) is retried
-      once on the same backend, then the chunk falls back to the NumPy
-      reference path (a ``mesh`` run falls back the same way: the mesh
-      route is dropped along with the jax backend);
+      on the same backend (``policy.retries`` times, default once, with
+      exponential ``policy.backoff_s`` between attempts), then the chunk
+      falls back to the NumPy reference path (a ``mesh`` run falls back
+      the same way: the mesh route is dropped along with the jax
+      backend).  With ``policy.timeout_s`` set, every device attempt
+      runs on a watchdog thread (:func:`..faults.policy.
+      call_with_deadline`) so a *wedged* dispatch — previously an
+      infinite stall — is bounded by ``timeout_s × (retries + 1)``
+      before the fallback;
     - the fallback decision is remembered in ``state`` (a mutable dict
       shared across the chunk loop), so a persistently broken device is
       discovered once — not re-discovered with two doomed attempts per
       chunk — and every subsequent chunk runs on the same backend/kernel
       (one consistent trial grid in the candidate store).
+
+    Retries are counted (``putpu_dispatch_retries_total``) and each
+    retry attempt is a ``dispatch_retry`` span, so a flaky device is
+    visible in the metrics snapshot and the Chrome trace.
 
     ``mesh`` routes the chunk through the sharded multi-device searches
     (``kernel="hybrid"`` -> :func:`..parallel.sharded_fdmt.sharded_hybrid_search`,
@@ -75,16 +92,25 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
     chunk pays one coarse dispatch and no seed rescore — the same
     gating as the single-device fused path.
     """
+    policy = policy if policy is not None else DispatchPolicy()
     state = state if state is not None else {}
     bk = state.get("backend", backend)
     kern = state.get("kernel", kernel)
-    attempts = [(bk, kern), (bk, kern)]
+    attempts = [(bk, kern)] * (1 + max(int(policy.retries), 0))
     if bk != "numpy":
         attempts.append(("numpy", "auto"))
     last = None
 
     def run_one(b, k):
+        if b != "numpy":
+            # the numpy reference path is the last-resort fallback this
+            # ladder exists to reach: injecting there too would make a
+            # *persistent* dispatch fault (FaultSpec times=None) crash
+            # the run through the very fallback the harness must prove
+            # (code-review r8)
+            fault_inject.fire("dispatch", chunk=chunk, backend=b)
         if mesh is not None and b == "jax":
+            fault_inject.fire("mesh", chunk=chunk)
             # plane capture on the mesh path stays DM-sharded and
             # device-resident (a ShardedPlane handle; the downstream
             # period search and diagnostics consume shard-local products
@@ -114,7 +140,23 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
 
     for i, (b, k) in enumerate(attempts):
         try:
-            result = run_one(b, k)
+            # the numpy reference path is the reliability floor: no
+            # watchdog (a deadline there would turn the last-resort
+            # fallback into another way to fail)
+            timeout = policy.timeout_s if b != "numpy" else None
+            if i and (b, k) == (bk, kern):
+                # a same-backend RETRY: counted, backed off, and traced
+                # as one — the numpy fallback attempt is neither (span
+                # and counter must agree; code-review r8)
+                obs_metrics.counter("putpu_dispatch_retries_total").inc()
+                if policy.backoff_s:
+                    time.sleep(policy.backoff_s * (2 ** (i - 1)))
+                with trace_span("dispatch_retry", chunk=chunk, attempt=i,
+                                backend=b):
+                    result = call_with_deadline(
+                        lambda: run_one(b, k), timeout)
+            else:
+                result = call_with_deadline(lambda: run_one(b, k), timeout)
             if (b, k) != (bk, kern):
                 logger.error(
                     "device search failed persistently; the rest of this "
@@ -134,6 +176,18 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
     raise last
 
 
+class _ReadFailure:
+    """Sentinel from the reader thread: a chunk's read failed even after
+    the bounded retries.  The chunk loop quarantines that one chunk
+    (done-with-reason in the ledger, a manifest record) instead of the
+    whole stream dying on one bad disk region."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      dmmin=200, dmmax=800, surelybad=(), *, backend="jax",
                      kernel="auto", snr_threshold=6.0, output_dir=None,
@@ -142,7 +196,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      progress=True, period_search=False,
                      period_sigma_threshold=8.0, show_plots=False,
                      mesh=None, exact_floor="auto", overlap_persist=True,
-                     budget=None):
+                     budget=None, dispatch_timeout=None, dispatch_retries=1,
+                     dispatch_backoff=0.0, quarantine_policy="sanitize",
+                     persist_retries=2, persist_backoff=0.05):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -226,6 +282,42 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     of its wall clock; this layer exists so that can never happen
     silently again).
 
+    Robustness knobs (ISSUE 4; see ``docs/robustness.md``).  On clean
+    (all-finite) input the defaults reproduce the pre-hardening data
+    path exactly — pinned by test; on data the integrity gate flags,
+    the defaults *deliberately* diverge (sanitize or quarantine where
+    the old path searched garbage); pass ``quarantine_policy="off"``
+    for the literal pre-hardening behaviour:
+
+    * ``dispatch_timeout`` (seconds, default off) bounds each device
+      dispatch on a watchdog thread — a wedged device used to stall the
+      stream forever; with a deadline the chunk proceeds to retry /
+      numpy fallback within ``dispatch_timeout × (dispatch_retries +
+      1)``.  Off by default (inline dispatch, byte-identical path);
+      when arming it, note the watchdog dispatches from a non-main
+      thread — device clients that require main-thread dispatch must
+      be tested first (``docs/robustness.md``).  ``dispatch_retries``
+      / ``dispatch_backoff`` shape the same-backend retry ladder
+      before the numpy fallback;
+    * ``quarantine_policy`` (``"sanitize"`` default / ``"strict"`` /
+      ``"off"``) arms the pre-search data-integrity gate: chunks whose
+      NaN/Inf, dead-channel, zero-run or saturation fractions breach
+      the :class:`~pulsarutils_tpu.faults.policy.IntegrityPolicy`
+      thresholds are **quarantined** — recorded in
+      ``quarantine_<fingerprint>.jsonl`` and marked done-with-reason in
+      the ledger (exact resume semantics) instead of poisoning the S/N
+      statistics or crashing; sub-threshold NaN chunks are sanitized
+      (non-finite values imputed, counted) under ``"sanitize"``.  The
+      gate runs on the reader thread (overlapped, not on the chunk's
+      serial critical path) and is skipped on the packed low-bit fast
+      path (integer samples cannot hold NaN/Inf);
+    * persist failures retry ``persist_retries`` times with exponential
+      ``persist_backoff`` and then **dead-letter** the chunk into the
+      quarantine manifest instead of failing the whole run on one bad
+      write; an end-of-run integrity audit
+      (:func:`~pulsarutils_tpu.faults.audit.audit_run`) cross-checks
+      ledger vs candidate files vs manifest and logs any inconsistency.
+
     Returns ``(hits, store)`` where hits is a list of
     ``(istart, iend, PulseInfo, ResultTable)``.  NOTE (round 6): when
     plotting is off, a hit's retained/persisted ``info.allprofs`` is the
@@ -255,6 +347,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 f"mesh axes {tuple(mesh.shape)} must include "
                 f"{sorted(needed)} for kernel={kernel!r} (build one with "
                 "make_mesh((d, c), ('dm', 'chan')))")
+    # resolved before any file IO so a bogus policy string fails fast
+    integrity = resolve_integrity_policy(quarantine_policy)
+    dispatch_policy = DispatchPolicy(timeout_s=dispatch_timeout,
+                                     retries=dispatch_retries,
+                                     backoff_s=dispatch_backoff)
     logger.info("opening %s", fname)
     # strip only the final extension: "obs.day1.fil" and "obs.day2.fil"
     # must keep distinct candidate roots in a shared output directory
@@ -274,7 +371,14 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
 
     with_timer = timer.bucket
     with with_timer("badchans"):
-        mask_fileorder = get_bad_chans(fname, surelybad=surelybad)
+        # the pre-scan streams the whole file through the same reader
+        # seam the chunk loop uses, but BEFORE the hardened loop
+        # exists: injection is suppressed here so an env-armed read
+        # fault targets the search chunks (and cannot crash the run at
+        # startup or silently eat a times=1 budget); the scan has its
+        # own resilience story (.badchans cache, restartable)
+        with fault_inject.suppressed():
+            mask_fileorder = get_bad_chans(fname, surelybad=surelybad)
 
     reader = FilterbankReader(fname)
     header = reader.header
@@ -373,10 +477,20 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         # same orphan-avoidance rule for the mesh route (device count
         # changes the f32 reduction shapes, not the science)
         **({"mesh": list(mesh.shape.values())} if mesh is not None else {}),
+        # and for the integrity gate: a non-default policy changes what
+        # gets searched on flagged data, so its ledger must not be
+        # interchangeable with the default's (a default-policy run
+        # keeps the pre-hardening fingerprint — no orphaned ledgers)
+        **({"quarantine_policy": str(quarantine_policy)}
+           if quarantine_policy != "sanitize" else {}),
         surelybad=sorted(int(c) for c in surelybad),
         period_search=bool(period_search),
         period_sigma_threshold=float(period_sigma_threshold))
     store = CandidateStore(output_dir, fingerprint if resume else None)
+    # quarantine manifest: created lazily on first record, so a clean
+    # run's output directory is byte-identical to pre-hardening
+    manifest = QuarantineManifest(output_dir,
+                                  fingerprint if resume else None)
 
     hits = []
     nproc = 0
@@ -456,15 +570,66 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     from concurrent.futures import ThreadPoolExecutor
 
     def read_at(s):
+        """Read (and gate) one chunk on the reader thread.
+
+        Returns ``(block, gate_info)`` — ``gate_info`` is ``None`` when
+        the integrity gate is off or the packed fast path is in use,
+        else the verdict/stats dict from :func:`..faults.policy.
+        gate_chunk`.  A transient read error is retried (bounded,
+        counted); a persistent one returns a ``_ReadFailure`` sentinel
+        so the chunk loop quarantines the chunk instead of the whole
+        stream dying.  SCOPE: this contains read failures that surface
+        as ``OSError`` (network filesystems, injected faults); a bad
+        sector under the mmapped file raises SIGBUS, which no except
+        clause can catch — pread-based reads would be needed at the
+        sigproc seam to contain that class.
+        """
         t0 = time.perf_counter()
         try:
-            if packed_bits:
-                # packed bytes straight off the mmap: decode happens on
-                # device (or in the host fallback below on demand)
-                return reader.read_block_packed(s, min(plan.step,
-                                                       nsamples - s))
-            return reader.read_block(s, min(plan.step, nsamples - s),
-                                     band_ascending=True)
+            nread = min(plan.step, nsamples - s)
+            block = None
+            for attempt in range(3):
+                try:
+                    if packed_bits:
+                        # packed bytes straight off the mmap: decode
+                        # happens on device (or in the host fallback
+                        # below on demand)
+                        block = reader.read_block_packed(s, nread)
+                    else:
+                        block = reader.read_block(s, nread,
+                                                  band_ascending=True)
+                    break
+                except OSError as exc:
+                    if attempt == 2:
+                        logger.error("chunk %d read failed after %d "
+                                     "attempts (%r)", s, attempt + 1, exc)
+                        return _ReadFailure(exc), None
+                    obs_metrics.counter("putpu_read_retries_total").inc()
+                    logger.warning("chunk %d read error (%r); retrying",
+                                   s, exc)
+                    # backoff before re-reading (reader thread — off the
+                    # critical path): immediate retries would exhaust
+                    # the budget in microseconds and quarantine a chunk
+                    # over a sub-second I/O blip (code-review r8)
+                    time.sleep(0.1 * (2 ** attempt))
+            if not packed_bits:
+                block = fault_inject.corrupt("corrupt", block, chunk=s)
+                # the gate only makes sense for full-rate samples:
+                # quantized low-bit data (1/2/4-bit — packed fast path
+                # OR host-decoded) cannot hold NaN/Inf, and its
+                # saturation/zero fractions sit at the quantization
+                # levels by construction (a 1-bit chunk is ~50% "at the
+                # rail"), so gating it would false-quarantine healthy
+                # chunks (code-review r8)
+                if integrity is not None \
+                        and reader._nbits not in (1, 2, 4):
+                    # gated HERE, on the reader thread: the stats pass
+                    # overlaps the previous chunk's device work instead
+                    # of sitting on the chunk's serial critical path
+                    block, gate_info = gate_chunk(np.asarray(block),
+                                                  integrity)
+                    return block, gate_info
+            return block, None
         finally:
             # reader-thread seconds: overlapped with the previous
             # chunk's device work, so accounted but not in any chunk's
@@ -489,7 +654,14 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         try:
             import jax
 
-            host = read_future.result()
+            host, gate_info = read_future.result()
+            if isinstance(host, _ReadFailure) or (
+                    gate_info is not None
+                    and gate_info["verdict"] != "clean"):
+                # failed/sanitized/quarantined chunks skip the prefetch:
+                # the main path handles them (and must never upload the
+                # un-sanitized bytes)
+                return None
             buf = jax.device_put(host)
             timer.count("prefetch_uploads")
             obs_metrics.counter("putpu_bytes_uploaded_total").inc(
@@ -507,15 +679,48 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     else None)
     persist_futures = []
 
-    def _persist_and_mark(payload, istart_, iend_):
-        if payload is not None:
-            store.save_candidate(root, istart_, iend_, *payload)
-        store.mark_done(istart_)
+    def _persist_and_mark(payload, istart_, iend_, reason=None):
+        """Persist + mark done, with bounded retry and a dead-letter.
 
-    def _persist_async(payload, istart_, iend_, pspan=None):
+        A write failure used to fail the whole run (the overlap only
+        deferred the raise).  Now: ``persist_retries`` bounded retries
+        with exponential backoff, then a ``persist_dead_letter`` record
+        in the quarantine manifest and done-with-reason in the ledger —
+        the run continues, the audit knows the candidate is missing on
+        purpose.  Only ``OSError`` is retried: anything else is a bug,
+        not a disk hiccup, and still propagates.
+        """
+        if payload is not None:
+            for attempt in range(max(int(persist_retries), 0) + 1):
+                try:
+                    store.save_candidate(root, istart_, iend_, *payload)
+                    break
+                except OSError as exc:
+                    if attempt < persist_retries:
+                        obs_metrics.counter(
+                            "putpu_persist_retries_total").inc()
+                        logger.warning(
+                            "persist of chunk %d-%d failed (%r); "
+                            "retry %d/%d", istart_, iend_, exc,
+                            attempt + 1, persist_retries)
+                        time.sleep(persist_backoff * (2 ** attempt))
+                    else:
+                        obs_metrics.counter(
+                            "putpu_persist_dead_letter_total").inc()
+                        logger.error(
+                            "persist of chunk %d-%d failed %d times "
+                            "(%r): dead-letter recorded, run continues",
+                            istart_, iend_, attempt + 1, exc)
+                        manifest.record(istart_, iend_,
+                                        "persist_dead_letter",
+                                        {"error": repr(exc)})
+                        reason = "persist_dead_letter"
+        store.mark_done(istart_, reason=reason)
+
+    def _persist_async(payload, istart_, iend_, pspan=None, reason=None):
         t0 = time.perf_counter()
         try:
-            _persist_and_mark(payload, istart_, iend_)
+            _persist_and_mark(payload, istart_, iend_, reason=reason)
         finally:
             timer.add_async("persist", time.perf_counter() - t0)
             if pspan is not None:
@@ -525,8 +730,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 pspan.end()
 
     def _drain_persist(block=False):
-        # serial semantics: a failed save must fail the run — the
-        # overlap only defers the raise to the next drain point
+        # serial semantics: a persist failure that survives the retry +
+        # dead-letter policy (i.e. a bug, not a disk hiccup) must fail
+        # the run — the overlap only defers the raise to the next drain
         while persist_futures and (block or persist_futures[0].done()):
             persist_futures.pop(0).result()
 
@@ -541,9 +747,55 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             t0 = istart * sample_time
 
             with with_timer("read"):
-                array = next_read.result()
+                array, gate_info = next_read.result()
             next_read = (reader_pool.submit(read_at, todo[ichunk + 1])
                          if ichunk + 1 < len(todo) else None)
+
+            # -- failure containment: quarantine, never poison/crash --
+            # an unreadable, truncated or unrecoverably corrupt chunk is
+            # recorded (manifest + done-with-reason in the ledger, so
+            # resume never retries it) and the stream moves on
+            quarantine_reason = q_stats = None
+            if isinstance(array, _ReadFailure):
+                quarantine_reason = "read_error"
+                q_stats = {"error": repr(array.exc)}
+            else:
+                got = array.shape[0] if packed_bits else array.shape[1]
+                if got < chunk_size:
+                    quarantine_reason = "short_read"
+                    q_stats = {"expected": int(chunk_size),
+                               "got": int(got)}
+                elif gate_info is not None:
+                    if gate_info["verdict"] == "quarantine":
+                        quarantine_reason = "integrity:" + ",".join(
+                            gate_info["reasons"])
+                        q_stats = gate_info["stats"]
+                    elif gate_info["verdict"] == "sanitized":
+                        obs_metrics.counter(
+                            "putpu_chunks_sanitized_total").inc()
+                        logger.warning(
+                            "chunk %d-%d sanitized (non-finite values "
+                            "imputed): %s", istart, iend,
+                            gate_info["stats"])
+            if quarantine_reason is not None:
+                obs_metrics.counter(
+                    "putpu_chunks_quarantined_total").inc()
+                logger.error("chunk %d-%d QUARANTINED (%s): %s -> %s",
+                             istart, iend, quarantine_reason, q_stats,
+                             manifest.path)
+                manifest.record(istart, iend, quarantine_reason, q_stats)
+                if persist_pool is not None:
+                    persist_futures.append(persist_pool.submit(
+                        _persist_async, None, istart, iend,
+                        reason=quarantine_reason))
+                else:
+                    with with_timer("persist"):
+                        _persist_and_mark(None, istart, iend,
+                                          reason=quarantine_reason)
+                array_dev = None  # drop any prefetched device copy
+                nproc += 1
+                continue
+
             src = None
             if device_clean is not None:
                 with with_timer("upload_wait"):
@@ -616,7 +868,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
                     backend=backend, kernel=kernel, capture_plane=capture,
                     state=fallback_state, mesh=mesh,
-                    snr_floor=search_snr_floor)
+                    snr_floor=search_snr_floor, chunk=istart,
+                    policy=dispatch_policy)
             table, plane = result if capture else (result, None)
 
             best = table.best_row()
@@ -798,7 +1051,15 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 continue
             try:
                 info, table = store.load_candidate(root, lo, hi)
-            except Exception as exc:  # a partial/corrupt pair: skip it
+            # the actual load failure modes of a partial/corrupt npz
+            # pair (missing file, truncated zip, bad member, bad json,
+            # bit-rotted deflate stream) — anything else is a bug and
+            # must propagate, and every skip is counted so silent
+            # skips show in the metrics snapshot (ISSUE 4 satellite)
+            except (OSError, ValueError, KeyError, EOFError,
+                    zipfile.BadZipFile, zlib.error) as exc:
+                obs_metrics.counter(
+                    "putpu_resume_pairs_skipped_total").inc()
                 logger.warning("could not restore candidate %s_%d-%d: %r",
                                root, lo, hi, exc)
                 continue
@@ -808,4 +1069,21 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             hits.sort(key=lambda h: h[0])
             logger.info("restored %d persisted candidate(s) from the "
                         "resume ledger", restored)
+        # end-of-run integrity audit: ledger vs candidate files vs
+        # quarantine manifest (read-only; inconsistencies are logged
+        # and counted, never fatal — observability must not take down
+        # a survey run)
+        from ..faults.audit import audit_run
+
+        try:
+            report = audit_run(output_dir, fingerprint, root=root)
+        except Exception as exc:  # never fatal — by contract
+            logger.warning("integrity audit failed (%r); run result is "
+                           "unaffected", exc)
+        else:
+            if report["issues"]:
+                logger.warning("integrity audit: %d inconsistencies: %s",
+                               len(report["issues"]), report["issues"])
+            else:
+                logger.info("integrity audit: ok %s", report["checked"])
     return hits, store

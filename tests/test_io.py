@@ -311,3 +311,81 @@ def test_read_block_packed_rejects_wide_types(tmp_path):
         w.write_block(np.ones((4, 8), np.float32))
     with pytest.raises(ValueError, match="packed"):
         FilterbankReader(path).read_block_packed(0, 8)
+
+
+# ---------------------------------------------------------------------------
+# Truncated files (ISSUE 4 satellite): short reads must fail cleanly
+# ---------------------------------------------------------------------------
+
+def _write_small_fil(tmp_path, nchan=8, nsamples=256):
+    data = np.random.default_rng(7).normal(50, 5,
+                                           (nchan, nsamples)).astype(
+        np.float32)
+    path = str(tmp_path / "trunc.fil")
+    write_filterbank(path, data, tsamp=1e-4, fch1=1500.0, foff=-0.5)
+    return path, data
+
+
+def test_truncated_mid_header_clean_valueerror(tmp_path):
+    """A file cut mid-header used to surface as a raw struct.error from
+    struct.unpack; now a ValueError names the byte offset and the
+    expected length."""
+    import struct
+
+    path, _ = _write_small_fil(tmp_path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    # cut inside the header (well before HEADER_END): a few truncation
+    # points so both string-length and value reads are exercised
+    for cut in (2, 7, 21, 40):
+        short = str(tmp_path / f"cut{cut}.fil")
+        with open(short, "wb") as f:
+            f.write(blob[:cut])
+        with pytest.raises(ValueError, match="byte offset") as ei:
+            read_header(short)
+        assert "expected" in str(ei.value)
+        # never the raw struct error
+        assert not isinstance(ei.value, struct.error)
+
+
+def test_truncated_mid_data_reads_what_exists(tmp_path):
+    """A file cut mid-data (interrupted write / partial transfer) keeps
+    working: nsamples reflects what is actually present and reads clamp
+    to it instead of crashing the memmap."""
+    path, data = _write_small_fil(tmp_path, nchan=8, nsamples=256)
+    with open(path, "rb") as f:
+        blob = f.read()
+    _, offset = read_header(path)
+    frame = 8 * 4  # nchan * float32
+    # cut mid-frame after 100 complete frames
+    short = str(tmp_path / "middata.fil")
+    with open(short, "wb") as f:
+        f.write(blob[: offset + 100 * frame + 13])
+    r = FilterbankReader(short)
+    assert r.nsamples == 100
+    block = r.read_block(0, 256)  # over-ask: clamps to what exists
+    assert block.shape == (8, 100)
+    assert np.allclose(block, data[:, :100])
+
+
+def test_read_block_fault_injection_hooks(tmp_path):
+    """The reader seam honours an armed FaultPlan: injected I/O errors
+    raise OSError, truncate specs shorten the block — and with no plan
+    armed the path is untouched."""
+    from pulsarutils_tpu.faults import FaultPlan, FaultSpec
+
+    path, data = _write_small_fil(tmp_path)
+    r = FilterbankReader(path)
+    plan = FaultPlan([
+        FaultSpec(site="read", kind="error", chunks=(0,), times=1),
+        FaultSpec(site="read", kind="truncate", chunks=(128,), frac=0.5,
+                  times=1),
+    ])
+    with plan.armed():
+        with pytest.raises(OSError, match="FAULTPLAN"):
+            r.read_block(0, 64)
+        assert r.read_block(0, 64).shape == (8, 64)  # budget spent
+        assert r.read_block(128, 64).shape == (8, 32)  # truncated once
+        assert r.read_block(128, 64).shape == (8, 64)
+    assert plan.fired() == 2
+    assert r.read_block(0, 64).shape == (8, 64)

@@ -1,0 +1,316 @@
+"""Chaos drill: the survey loop's failure policy, proven end-to-end.
+
+Runs the full ``search_by_chunks`` survey (small synthetic file, CPU)
+under a fault matrix — every fault class from
+:mod:`pulsarutils_tpu.faults.inject` x recoverable/unrecoverable — and
+asserts the contracts ``docs/robustness.md`` documents:
+
+* every **recoverable** class (transient dispatch error, bounded hang,
+  transient persist error, transient read error, sanitizable NaN chunk,
+  dead channels, torn ledger at resume) completes with candidates and
+  ledger **byte-identical** to the fault-free baseline run (candidate
+  npz files are compared member-by-member on raw array bytes — zip
+  timestamps are the only allowed difference);
+* every **unrecoverable** class (hard-corrupt chunk, truncated read,
+  persist dead-letter) completes the run with the affected chunks
+  recorded in the quarantine manifest + marked done-with-reason in the
+  ledger, the *unaffected* chunks' outputs still byte-identical, and
+  the integrity audit reporting zero inconsistencies.
+
+Wired as ``bench_suite.py`` config 9 so the drill result lands next to
+the perf-gate artifacts; the same matrix runs as a ``slow``+``chaos``
+pytest in ``tests/test_faults.py``.
+
+Usage: JAX_PLATFORMS=cpu python tools/chaos_drill.py [--out drill.json]
+"""
+
+import argparse
+import contextlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TSAMP = 0.0005
+NCHAN = 64
+NSAMPLES = 32768
+CHUNK_LEN_S = 8192 * TSAMP
+DM = 150.0
+PULSE_T = 20000
+#: chunk starts for this geometry (step 16384, hop 8192); the pulse
+#: (and its ~230-sample dispersed track) lives entirely in the two
+#: overlapping chunks starting at 8192/16384 — chunk 0 is pure noise,
+#: so corruption injected there must not change the candidate set
+NOISE_CHUNK = 0
+CHUNKS = (0, 8192, 16384)
+#: the two overlapping chunks that contain the pulse — the only ones
+#: that persist a candidate, hence the only ones a persist dead-letter
+#: can affect
+HIT_CHUNKS = (8192, 16384)
+
+#: snr_threshold 6.5, not the reference 6.0: this geometry's noise
+#: ceiling grazes 6.0 (chunk 0 produced a marginal 6.02 noise
+#: "candidate"), and the drill needs its noise chunk genuinely
+#: candidate-free so corruption injected there cannot perturb a
+#: borderline detection — the byte-identical contract is about failure
+#: handling, not about pinning noise-floor coin flips
+SEARCH_KW = dict(dmmin=100, dmmax=200, backend="jax",
+                 chunk_length=CHUNK_LEN_S, make_plots=False,
+                 progress=False, snr_threshold=6.5)
+
+
+def make_survey_file(path):
+    """Deterministic small survey: noise + ONE bright dispersed pulse."""
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import disperse_array
+
+    rng = np.random.default_rng(0)
+    array = np.abs(rng.normal(0, 0.5, (NCHAN, NSAMPLES))) + 20.0
+    array[:, PULSE_T] += 4.0
+    array = disperse_array(array, DM, 1200., 200., TSAMP)
+    sim_header = {"bandwidth": 200., "fbottom": 1200., "nchans": NCHAN,
+                  "nsamples": NSAMPLES, "tsamp": TSAMP,
+                  "foff": 200. / NCHAN}
+    write_simulated_filterbank(path, array, sim_header, descending=True)
+    return path
+
+
+def run_search(path, outdir, plan=None, **kw):
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+    params = dict(SEARCH_KW, output_dir=outdir, **kw)
+    ctx = plan.armed() if plan is not None else contextlib.nullcontext()
+    with ctx:
+        return search_by_chunks(path, **params)
+
+
+def snapshot_outputs(outdir, fingerprint):
+    """Byte-level snapshot of a run's durable outputs.
+
+    The ledger is raw file bytes.  Candidate npz files are snapshotted
+    member-by-member (name, dtype, shape, raw array bytes): the zip
+    container embeds write timestamps, so whole-file byte comparison
+    would be flaky by construction while the *content* comparison is
+    exact.
+    """
+    ledger_path = os.path.join(outdir, f"progress_{fingerprint}.json")
+    with open(ledger_path, "rb") as f:
+        ledger = f.read()
+    cands = {}
+    for name in sorted(os.listdir(outdir)):
+        if not name.endswith(".npz"):
+            continue
+        with np.load(os.path.join(outdir, name),
+                     allow_pickle=False) as data:
+            cands[name] = {k: (str(data[k].dtype), data[k].shape,
+                               data[k].tobytes()) for k in data.files}
+    return {"ledger": ledger, "cands": cands}
+
+
+def diff_outputs(base, fresh, ignore_ledger=False):
+    """Human-readable list of differences (empty = byte-identical)."""
+    diffs = []
+    if not ignore_ledger and base["ledger"] != fresh["ledger"]:
+        diffs.append(f"ledger bytes differ: {base['ledger']!r} != "
+                     f"{fresh['ledger']!r}")
+    missing = set(base["cands"]) - set(fresh["cands"])
+    extra = set(fresh["cands"]) - set(base["cands"])
+    if missing:
+        diffs.append(f"candidate files missing: {sorted(missing)}")
+    if extra:
+        diffs.append(f"unexpected candidate files: {sorted(extra)}")
+    for name in sorted(set(base["cands"]) & set(fresh["cands"])):
+        b, f = base["cands"][name], fresh["cands"][name]
+        if set(b) != set(f):
+            diffs.append(f"{name}: member sets differ")
+            continue
+        for k in sorted(b):
+            if b[k] != f[k]:
+                diffs.append(f"{name}:{k}: bytes differ")
+    return diffs
+
+
+def _fault_classes():
+    """The drill matrix: name -> (recoverable, plan specs, extra search
+    kwargs, affected chunks for unrecoverable classes)."""
+    from pulsarutils_tpu.faults.inject import FaultSpec
+
+    return {
+        # -- recoverable: outputs must be byte-identical to baseline --
+        "transient_dispatch": (True, [FaultSpec(
+            site="dispatch", kind="error", chunks=(8192,), times=1)],
+            {}, None),
+        # timeout 5s, not sub-second: the deadline must sit comfortably
+        # above a LOADED machine's healthy chunk search (the baseline
+        # run already warmed the jit cache, but shared CPU runners
+        # stretch the search wall), or legitimate retries time out too
+        # and the run stickily degrades to numpy — breaking the
+        # byte-identity contract for the wrong reason (code-review r8).
+        # The sub-second-bounded-hang pin lives in tests/test_faults.py.
+        "transient_hang": (True, [FaultSpec(
+            site="dispatch", kind="hang", seconds=30.0, chunks=(0,),
+            times=1)],
+            {"dispatch_timeout": 5.0, "dispatch_retries": 2,
+             "dispatch_backoff": 0.01}, None),
+        "transient_persist": (True, [FaultSpec(
+            site="persist", kind="error", times=1)],
+            {"persist_backoff": 0.01}, None),
+        "transient_read": (True, [FaultSpec(
+            site="read", kind="error", chunks=(8192,), times=1)],
+            {}, None),
+        "sanitizable_nan": (True, [FaultSpec(
+            site="corrupt", kind="nan", chunks=(NOISE_CHUNK,),
+            frac=0.02, times=1)],
+            {}, None),
+        "dead_channels": (True, [FaultSpec(
+            site="corrupt", kind="dead_channels", chunks=(NOISE_CHUNK,),
+            frac=0.1, times=1)],
+            {}, None),
+        # -- unrecoverable: contained, quarantined, audited ------------
+        "hard_corrupt": (False, [FaultSpec(
+            site="corrupt", kind="nan", chunks=(NOISE_CHUNK,), frac=0.9,
+            times=1)],
+            {}, {NOISE_CHUNK}),
+        "truncated_read": (False, [FaultSpec(
+            site="read", kind="truncate", chunks=(NOISE_CHUNK,),
+            frac=0.5, times=3)],
+            {}, {NOISE_CHUNK}),
+        "dead_letter": (False, [FaultSpec(
+            site="persist", kind="error", times=None)],
+            {"persist_backoff": 0.01}, set(HIT_CHUNKS)),
+    }
+
+
+def run_drill(quick=False, log=print, workdir=None, keep=False):
+    """Run the whole matrix; returns the result record (config-9 style).
+
+    ``quick`` currently runs the identical matrix (the survey is already
+    tier-1 sized); the flag is accepted so bench_suite's preset plumbing
+    stays uniform.
+    """
+    from pulsarutils_tpu.faults.audit import audit_run
+    from pulsarutils_tpu.faults.inject import FaultPlan
+    from pulsarutils_tpu.pipeline.spectral_stats import get_bad_chans
+
+    t_start = time.time()
+    base_dir = workdir or tempfile.mkdtemp(prefix="chaos_drill_")
+    os.makedirs(base_dir, exist_ok=True)
+    path = os.path.join(base_dir, "survey.fil")
+    make_survey_file(path)
+    # warm the bad-channel cache BEFORE any plan is armed: its streaming
+    # scan shares the reader seam, and the drill targets search chunks,
+    # not the scan's blocks
+    get_bad_chans(path)
+
+    log("chaos drill: fault-free baseline run")
+    hits, store = run_search(path, os.path.join(base_dir, "baseline"))
+    fingerprint = store.fingerprint
+    assert hits, "baseline run found no candidates — drill is vacuous"
+    assert any(lo <= PULSE_T < hi for lo, hi, _, _ in hits)
+    baseline = snapshot_outputs(os.path.join(base_dir, "baseline"),
+                                fingerprint)
+
+    classes = {}
+    for name, (recoverable, specs, kw, affected) in _fault_classes().items():
+        outdir = os.path.join(base_dir, name)
+        plan = FaultPlan(specs)
+        log(f"chaos drill: class {name} "
+            f"({'recoverable' if recoverable else 'unrecoverable'})")
+        t0 = time.time()
+        hits_f, store_f = run_search(path, outdir, plan=plan, **kw)
+        fresh = snapshot_outputs(outdir, fingerprint)
+        rec = {"recoverable": recoverable, "fired": plan.fired(),
+               "hits": len(hits_f), "wall_s": round(time.time() - t0, 2)}
+        if recoverable:
+            diffs = diff_outputs(baseline, fresh)
+            rec["byte_identical"] = not diffs
+            rec["diffs"] = diffs
+            rec["ok"] = bool(plan.fired()) and not diffs
+        else:
+            report = audit_run(outdir, fingerprint, root="survey")
+            quarantined = {int(k) for k in
+                           store_f.quarantined_chunks}
+            rec["quarantined"] = sorted(quarantined)
+            rec["audit_ok"] = report["ok"]
+            rec["audit_issues"] = report["issues"]
+            # the unaffected chunks' outputs must still match baseline
+            sub_base = {"ledger": b"", "cands": {
+                n: v for n, v in baseline["cands"].items()
+                if not any(f"_{c}-" in n for c in affected)}}
+            sub_fresh = {"ledger": b"", "cands": {
+                n: v for n, v in fresh["cands"].items()
+                if not any(f"_{c}-" in n for c in affected)}}
+            diffs = diff_outputs(sub_base, sub_fresh, ignore_ledger=True)
+            rec["diffs"] = diffs
+            rec["ok"] = (bool(plan.fired()) and report["ok"]
+                         and affected <= quarantined and not diffs)
+        classes[name] = rec
+        log(f"chaos drill: class {name}: "
+            f"{'PASS' if rec['ok'] else 'FAIL ' + str(rec)}")
+
+    # torn ledger at resume: no FaultPlan — the fault is a truncated
+    # progress file between two resumed sessions
+    log("chaos drill: class torn_ledger (recoverable)")
+    outdir = os.path.join(base_dir, "torn_ledger")
+    t0 = time.time()
+    run_search(path, outdir, max_chunks=2)
+    ledger_path = os.path.join(outdir, f"progress_{fingerprint}.json")
+    with open(ledger_path, "rb") as f:
+        blob = f.read()
+    with open(ledger_path, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # torn mid-file
+    hits_t, _ = run_search(path, outdir)
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    classes["torn_ledger"] = {
+        "recoverable": True, "fired": 1, "hits": len(hits_t),
+        "wall_s": round(time.time() - t0, 2),
+        "byte_identical": not diffs, "diffs": diffs,
+        "backup_kept": os.path.exists(ledger_path + ".corrupt"),
+        "ok": not diffs and os.path.exists(ledger_path + ".corrupt")}
+    log(f"chaos drill: class torn_ledger: "
+        f"{'PASS' if classes['torn_ledger']['ok'] else 'FAIL'}")
+
+    recovered = sum(1 for r in classes.values()
+                    if r["recoverable"] and r["ok"])
+    contained = sum(1 for r in classes.values()
+                    if not r["recoverable"] and r["ok"])
+    result = {
+        "survey": {"nchan": NCHAN, "nsamples": NSAMPLES,
+                   "chunks": list(CHUNKS), "pulse_dm": DM},
+        "n_classes": len(classes),
+        "recovered_identical": recovered,
+        "contained": contained,
+        "all_ok": all(r["ok"] for r in classes.values()),
+        "classes": classes,
+        "wall_s": round(time.time() - t_start, 2),
+    }
+    if not keep and workdir is None:
+        shutil.rmtree(base_dir, ignore_errors=True)
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default=None, help="write the JSON record here")
+    p.add_argument("--workdir", default=None,
+                   help="run under this directory (kept) instead of a "
+                        "deleted tempdir")
+    opts = p.parse_args(argv)
+    result = run_drill(log=lambda m: print(m, file=sys.stderr, flush=True),
+                       workdir=opts.workdir, keep=bool(opts.workdir))
+    print(json.dumps(result, indent=1))
+    if opts.out:
+        with open(opts.out, "w") as f:
+            json.dump(result, f, indent=1)
+    return 0 if result["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
