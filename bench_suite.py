@@ -20,6 +20,11 @@ all). Failures in one config don't stop the others.
   9  chaos drill (tools/chaos_drill.py): the full survey loop under the
      fault matrix — recoverable classes byte-identical to the
      fault-free run, unrecoverable classes quarantined + audited
+ 10  canary survey (ISSUE 5): short survey with canary pulses injected
+     into EVERY chunk plus one injected RFI-storm chunk — emits live
+     recall (the gated value), S/N recovery, DM error and the health
+     engine's verdict transitions (must flip to DEGRADED on the storm
+     and recover)
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -502,10 +507,123 @@ def config9(quick):
           "classes": {k: v["ok"] for k, v in result["classes"].items()}})
 
 
+def config10(quick):
+    """Canary-enabled rehearsal survey (ISSUE 5): detection efficiency
+    as a gated number.  A short on-disk survey runs with a canary pulse
+    injected into EVERY chunk (so recall is computed from >= 10
+    injections) and ONE chunk hit by an injected broadband RFI storm
+    (``faults.inject`` kind="impulse").  The emitted value is the
+    canary recall — ``tools/perf_gate.py`` gates on it alongside the
+    perf configs, so a change that silently degrades *detection* (not
+    speed) fails the same gate.  The record also carries the health
+    engine's verdict transitions: the storm must flip the verdict to
+    DEGRADED (candidate-rate spike) and the clean chunks after it must
+    bring it back to OK.
+    """
+    import tempfile
+    import threading
+    import urllib.request
+
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.obs.canary import CanaryController
+    from pulsarutils_tpu.obs.health import HealthEngine
+    from pulsarutils_tpu.obs.server import start_obs_server
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+    tsamp = 0.0005
+    nchan = 64
+    hop = 4096
+    nhops = 14  # ~13 overlapped chunks — already tier-1 scale on CPU
+    nsamples = nhops * hop
+    rng = np.random.default_rng(10)
+    array = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+    header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+              "nsamples": nsamples, "tsamp": tsamp, "foff": 200. / nchan}
+    storm_chunk = 5 * hop
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "canary.fil")
+        write_simulated_filterbank(path, array, header, descending=True)
+        # 8 impulses at 100 block-stds: bright enough that the wide
+        # boxcar widths light up ~2/3 of the DM trials (a denser storm
+        # self-suppresses — the row-std normalisation soaks it up)
+        plan = FaultPlan([FaultSpec(site="corrupt", kind="impulse",
+                                    chunks=(storm_chunk,), frac=0.001,
+                                    times=1, amp=100.0)])
+        canary = CanaryController(rate=1.0, snr=15.0, seed=10)
+        engine = HealthEngine()
+        # the live surface is part of what this config proves: a
+        # scraper thread polls the REAL /metrics endpoint while the
+        # survey runs and records the recall it saw on the wire once
+        # >= 10 canaries had been injected
+        srv = start_obs_server(0, health=engine,
+                               progress_fn=lambda: canary.summary())
+        scraped = {"recall": None, "injected": 0, "statuses": set()}
+        stop = threading.Event()
+
+        def scraper():
+            base = f"http://127.0.0.1:{srv.port}"
+            while not stop.is_set():
+                try:
+                    text = urllib.request.urlopen(
+                        base + "/metrics", timeout=2.0).read().decode()
+                    doc = json.loads(urllib.request.urlopen(
+                        base + "/progress", timeout=2.0).read().decode())
+                except Exception:
+                    stop.wait(0.1)
+                    continue
+                scraped["statuses"].add(doc.get("status"))
+                inj = doc.get("injected") or 0
+                for line in text.splitlines():
+                    if line.startswith("putpu_canary_recall "):
+                        if inj >= 10:
+                            scraped["recall"] = float(line.split()[1])
+                            scraped["injected"] = inj
+                stop.wait(0.1)
+
+        poll = threading.Thread(target=scraper, daemon=True)
+        poll.start()
+        t0 = time.time()
+        try:
+            with plan.armed():
+                hits, _ = search_by_chunks(
+                    path, chunk_length=hop * tsamp, dmmin=100, dmmax=200,
+                    backend="jax", snr_threshold=6.5,
+                    output_dir=os.path.join(tmp, "out"),
+                    make_plots=False, resume=False, progress=False,
+                    canary=canary, health=engine)
+        finally:
+            stop.set()
+            poll.join(timeout=5.0)
+            srv.close()
+        wall = time.time() - t0
+    summary = canary.to_json()
+    summary.pop("curve", None)  # the snapshot stays one bounded line
+    reached = [t["to"] for t in engine.transitions]
+    emit({"config": 10, "metric": "canary survey: "
+          f"{summary['injected']} pulses injected (DM "
+          f"{summary['dm']}, target S/N {summary['target_snr']}) + 1 "
+          "RFI-storm chunk", "value": summary["recall"],
+          "unit": "canary recall (fraction recovered)",
+          "canary": summary,
+          "health_final": engine.verdict,
+          "health_reached_degraded": any(
+              v in ("DEGRADED", "CRITICAL") for v in reached),
+          "health_transitions": [
+              {"chunk": t["chunk"], "from": t["from"], "to": t["to"],
+               "reasons": t["reasons"]} for t in engine.transitions],
+          "scraped_live": {
+              "recall": scraped["recall"],
+              "injected_at_scrape": scraped["injected"],
+              "statuses_seen": sorted(s for s in scraped["statuses"]
+                                      if s)},
+          "hits": len(hits), "wall_s": round(wall, 2)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
-                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9])
+                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -522,7 +640,7 @@ def main(argv=None):
     except Exception:
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:
@@ -531,9 +649,13 @@ def main(argv=None):
             traceback.print_exc()
             emit({"config": c, "error": f"{type(exc).__name__}: {exc}"})
     if opts.metrics_out:
+        from pulsarutils_tpu.obs.gate import SCHEMA_VERSION
         from pulsarutils_tpu.obs.metrics import REGISTRY
 
         with open(opts.metrics_out, "w") as f:
+            # versioned header first: the gate REFUSES snapshots whose
+            # schema drifted instead of silently comparing them
+            f.write(json.dumps({"schema_version": SCHEMA_VERSION}) + "\n")
             for rec in RECORDS:
                 f.write(json.dumps(rec) + "\n")
             # registry tail: counters/gauges/histograms the configs'

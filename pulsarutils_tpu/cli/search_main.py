@@ -118,6 +118,41 @@ def build_parser():
                              "trips, bytes moved, roofline, memory "
                              "watermarks) to PATH — Prometheus textfile "
                              "format for a .prom suffix, JSONL otherwise")
+    parser.add_argument("--http-port", type=int, default=None,
+                        metavar="PORT",
+                        help="serve the live survey surface while the "
+                             "search runs: /metrics (Prometheus scrape), "
+                             "/healthz (OK/DEGRADED/CRITICAL verdict, "
+                             "HTTP 503 on CRITICAL), /progress (chunks "
+                             "done/total, ETA, canary recall).  0 binds "
+                             "an ephemeral port")
+    parser.add_argument("--http-host", default="127.0.0.1",
+                        metavar="ADDR",
+                        help="bind address for --http-port (default "
+                             "127.0.0.1: on-machine only; 0.0.0.0 "
+                             "exposes the surface to remote Prometheus "
+                             "scrapes / fleet healthz probes)")
+    parser.add_argument("--canary-rate", type=float, default=0.0,
+                        metavar="FRAC",
+                        help="inject a synthetic dispersed canary pulse "
+                             "into this fraction of chunks (reader "
+                             "thread) and measure live recall / S/N "
+                             "recovery / DM error; canary detections "
+                             "are tagged and excluded from candidates, "
+                             "ledger and sift.  0 (default) = off, "
+                             "byte-identical data path")
+    parser.add_argument("--canary-dm", type=float, default=None,
+                        help="canary DM (default: middle of the search "
+                             "range)")
+    parser.add_argument("--canary-snr", type=float, default=12.0,
+                        help="canary target S/N (default 12)")
+    parser.add_argument("--report-out", default=None, metavar="PATH",
+                        help="write the end-of-run survey report "
+                             "(PATH.md + self-contained PATH.html: "
+                             "budget buckets, roofline, canary recall "
+                             "curve, health incidents, sift counters, "
+                             "quarantine manifest); with several input "
+                             "files each gets PATH.<root>")
     return parser
 
 
@@ -155,6 +190,20 @@ def main(args=None):
     total_cands = 0
     with session:
       for fname in opts.fnames:
+        canary = None
+        if opts.canary_rate > 0:
+            from ..obs.canary import CanaryController
+
+            # one controller per file: recall is a per-run statement
+            canary = CanaryController(rate=opts.canary_rate,
+                                      dm=opts.canary_dm,
+                                      snr=opts.canary_snr)
+        report_out = opts.report_out
+        if report_out and len(opts.fnames) > 1:
+            import os as _os
+
+            root = _os.path.splitext(_os.path.basename(str(fname)))[0]
+            report_out = f"{report_out}.{root}"
         hits, _ = search_by_chunks(
             fname,
             chunk_length=opts.chunk_length,
@@ -179,12 +228,28 @@ def main(args=None):
             dispatch_timeout=opts.dispatch_timeout,
             dispatch_retries=opts.dispatch_retries,
             quarantine_policy=opts.quarantine_policy,
+            http_port=opts.http_port,
+            http_host=opts.http_host,
+            canary=canary,
+            report_out=report_out,
         )
         total_raw += len(hits)
         if hits and not opts.no_sift:
             from ..pipeline.sift import sift_hits
 
-            sifted = sift_hits(hits)
+            sift_stats = {}
+            sifted = sift_hits(hits, stats=sift_stats)
+            if report_out and sift_stats:
+                # the driver wrote the report before sift ran: fold
+                # the sift telemetry in now (observability must never
+                # fail the run, hence the containment)
+                from ..obs.report import amend_report
+
+                try:
+                    amend_report(report_out, sift=sift_stats)
+                except Exception as exc:
+                    logger.warning("could not amend the survey report "
+                                   "with sift telemetry (%r)", exc)
             total_cands += len(sifted)
             logger.info("%s: %d raw detections -> %d sifted candidates",
                         fname, len(hits), len(sifted))
@@ -197,12 +262,16 @@ def main(args=None):
     logger.info("total candidates: %d (%d raw detections)",
                 total_cands, total_raw)
     if opts.metrics_out:
+        from ..obs.gate import SCHEMA_VERSION
         from ..obs.metrics import REGISTRY
 
         if opts.metrics_out.endswith(".prom"):
+            # the .prom route is parsed by Prometheus itself — no
+            # JSON header line there
             n = REGISTRY.write_prometheus(opts.metrics_out)
         else:
-            n = REGISTRY.write_jsonl(opts.metrics_out)
+            n = REGISTRY.write_jsonl(opts.metrics_out,
+                                     schema_version=SCHEMA_VERSION)
         logger.info("metrics: %d lines -> %s", n, opts.metrics_out)
     return 0
 

@@ -15,7 +15,8 @@ site       seam                                                 kinds
 ``read``   ``FilterbankReader.read_block(_packed)``             ``error``, ``truncate``
 ``corrupt``the streaming driver's reader thread (post-decode)   ``nan``, ``inf``,
                                                                 ``dead_channels``,
-                                                                ``zero_run``, ``saturate``
+                                                                ``zero_run``, ``saturate``,
+                                                                ``impulse`` (RFI storm)
 ``dispatch``the per-chunk device search dispatch                ``error``, ``hang``
 ``mesh``   the sharded multi-device route inside the dispatch   ``error``, ``hang``
 ``persist````CandidateStore.save_candidate``                    ``error``
@@ -64,7 +65,8 @@ _EXC_TYPES = {
 #: default exception class per site when the spec names none
 _SITE_DEFAULT_EXC = {"read": "OSError", "persist": "OSError"}
 
-_CORRUPT_KINDS = ("nan", "inf", "dead_channels", "zero_run", "saturate")
+_CORRUPT_KINDS = ("nan", "inf", "dead_channels", "zero_run", "saturate",
+                  "impulse")
 
 
 @dataclasses.dataclass
@@ -81,6 +83,7 @@ class FaultSpec:
     seconds: float = 60.0           # hang duration
     seed: int = 0                   # corruption rng seed (mixed w/ chunk)
     exc: str | None = None          # exception class name for kind=error
+    amp: float = 20.0               # impulse amplitude, in block stds
     fired: int = dataclasses.field(default=0, init=False)
 
     def matches(self, site, chunk):
@@ -98,6 +101,8 @@ class FaultSpec:
             d["chunks"] = [int(c) for c in self.chunks]
         if self.exc is not None:
             d["exc"] = self.exc
+        if self.amp != 20.0:  # only when non-default: pre-existing plan
+            d["amp"] = self.amp  # JSON stays byte-stable
         return d
 
 
@@ -187,6 +192,19 @@ class FaultPlan:
             elif spec.kind == "dead_channels":
                 k = max(int(nchan * spec.frac), 1)
                 out[rng.choice(nchan, size=k, replace=False)] = 0.0
+            elif spec.kind == "impulse":
+                # broadband RFI storm: bright un-dispersed impulses in
+                # every channel at a few time bins — the classic
+                # candidate-rate-spike signature the health engine's
+                # storm detector exists for (ISSUE 5): many DM trials
+                # light up at once while no real pulse exists
+                k = max(int(nsamp * spec.frac), 1)
+                ts = rng.choice(nsamp, size=k, replace=False)
+                scale = float(np.nanstd(
+                    np.where(np.isinf(out), np.nan, out)))
+                if not np.isfinite(scale) or scale == 0.0:
+                    scale = 1.0
+                out[:, ts] += spec.amp * scale
             elif spec.kind == "zero_run":
                 # dropped packets: a contiguous run of zeroed frames
                 k = max(int(nsamp * spec.frac), 1)

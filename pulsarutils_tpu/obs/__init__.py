@@ -18,6 +18,18 @@ Three pillars (ISSUE 3):
 :mod:`.gate` holds the perf-regression comparison consumed by
 ``tools/perf_gate.py``.
 
+The **live surface** (ISSUE 5) builds on those pillars:
+
+* :mod:`.canary` — continuous synthetic-pulse injection-recovery:
+  detection efficiency (recall, S/N recovery, DM error) as live
+  metrics, byte-inert when disabled;
+* :mod:`.health` — rolling anomaly engine folding per-chunk telemetry
+  into one OK/DEGRADED/CRITICAL verdict with an incident log;
+* :mod:`.server` — stdlib HTTP endpoints ``/metrics`` (live Prometheus
+  scrape), ``/healthz`` (503 on CRITICAL), ``/progress``;
+* :mod:`.report` — the end-of-run self-contained survey report
+  (markdown + single-file HTML).
+
 Everything here is dependency-light (stdlib + lazy jax) and safe to
 import before a JAX backend exists.
 """
@@ -26,17 +38,32 @@ from . import gate, memory, metrics, roofline, trace
 from .metrics import REGISTRY
 from .trace import (begin_span, is_tracing, set_track, span, start_tracing,
                     stop_tracing, trace_session)
+# the live surface imports utils.logging_utils (which imports .metrics /
+# .trace) — keep these AFTER the pillar imports above so the partially
+# initialised package already exposes what the cycle re-enters for
+from . import canary, health, report, server
+from .canary import CanaryController
+from .health import HealthEngine
+from .server import ObsServer, start_obs_server
 
 __all__ = [
+    "CanaryController",
+    "HealthEngine",
+    "ObsServer",
     "REGISTRY",
     "begin_span",
+    "canary",
     "gate",
+    "health",
     "is_tracing",
     "memory",
     "metrics",
+    "report",
     "roofline",
+    "server",
     "set_track",
     "span",
+    "start_obs_server",
     "start_tracing",
     "stop_tracing",
     "trace",

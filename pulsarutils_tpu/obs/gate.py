@@ -24,8 +24,16 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["DEFAULT_REL_TOL", "load_snapshot", "lower_is_better",
-           "compare", "format_report"]
+__all__ = ["DEFAULT_REL_TOL", "SCHEMA_VERSION", "load_snapshot",
+           "lower_is_better", "compare", "format_report"]
+
+#: snapshot/footer schema version.  Written as the first line of every
+#: ``--metrics-out`` snapshot (``{"schema_version": N}``) and embedded
+#: in the ``BUDGET_JSON`` footer; bumped whenever a record's meaning
+#: changes.  The gate REJECTS a snapshot with a missing or mismatched
+#: version instead of silently comparing incompatible records — a
+#: schema drift must fail loudly, not pass as a 100%-ratio no-op.
+SCHEMA_VERSION = 1
 
 #: default relative tolerance — CPU wall-clock on shared runners jitters
 #: by tens of percent; the gate targets step regressions (2x+), so a
@@ -43,21 +51,37 @@ def lower_is_better(unit):
     return unit.startswith(_LATENCY_PREFIXES)
 
 
-def load_snapshot(path):
+def load_snapshot(path, expect_version=None):
     """Parse a ``--metrics-out`` snapshot (JSON lines) into
     ``{config_number: record}``.  Error records (``{"config": n,
     "error": ...}``) are kept — :func:`compare` fails them explicitly.
-    Lines without a ``config`` key (the metrics-registry tail) are
-    ignored."""
+    Lines without a ``config`` key (the ``schema_version`` header, the
+    metrics-registry tail) are not config records.
+
+    ``expect_version`` (the gate CLI passes :data:`SCHEMA_VERSION`)
+    enforces the snapshot schema: a missing or mismatched
+    ``schema_version`` header raises ``ValueError`` instead of letting
+    incompatible records be compared as if they agreed."""
     records = {}
+    version = None
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             rec = json.loads(line)
-            if isinstance(rec, dict) and "config" in rec:
+            if not isinstance(rec, dict):
+                continue
+            if "schema_version" in rec and "config" not in rec:
+                version = rec["schema_version"]
+            if "config" in rec:
                 records[int(rec["config"])] = rec
+    if expect_version is not None and version != expect_version:
+        raise ValueError(
+            f"snapshot {path}: schema_version is {version!r}, expected "
+            f"{expect_version!r} — regenerate it with the current "
+            "bench_suite.py --metrics-out (silently comparing across "
+            "schema versions is exactly what the gate must not do)")
     return records
 
 

@@ -1,0 +1,305 @@
+"""Rolling anomaly engine: per-chunk telemetry -> one health verdict.
+
+PR 3 made the survey *measurable* and PR 4 made it *survivable*; this
+module makes it **judgeable while it runs**.  :class:`HealthEngine`
+consumes one update per chunk — wall seconds, candidate count, headroom,
+retrace/retry/quarantine events, canary recall — and folds them through
+EWMA baselines with hysteresis into a single ``OK`` / ``DEGRADED`` /
+``CRITICAL`` verdict plus a reasoned incident log:
+
+* **slow chunks** — EWMA baseline on chunk wall time; a chunk several
+  times the baseline raises ``slow_chunk`` (a wedged link or a device
+  quietly retrying shows up here before the run "feels" slow);
+* **candidate storm** — EWMA baseline on the per-chunk candidate count
+  (table rows above the S/N threshold).  An RFI storm lights up *many*
+  DM trials at once, so a spike is the classic storm signature; a
+  sustained storm escalates to CRITICAL (the sift would drown);
+* **device headroom** — low free-HBM fraction degrades, near-zero is
+  critical (the next chunk is an OOM away);
+* **retraces / dispatch retries / quarantines / persist dead-letters**
+  — the robustness layer's counters become conditions, not just log
+  lines; a permanent numpy fallback is a sticky condition (the run
+  *works* but at reference speed — an operator must know);
+* **canary recall floor** — the one science-facing rule: once enough
+  canaries have been injected (:mod:`.canary`), a windowed recall below
+  the floor is CRITICAL even when every perf counter is green — this is
+  the "RFI storm or bad quantization step zeroes recall silently" case
+  the live surface exists to catch.
+
+Conditions use hysteresis: a raised condition stays active for
+``recover_after`` further updates unless re-raised, so the verdict does
+not flap chunk-to-chunk; sticky conditions never decay.  Verdict
+*transitions* are recorded separately from incidents so a drill (or an
+operator) can replay exactly when the run degraded and recovered.
+
+Thread-safe: the HTTP scrape thread (:mod:`.server`) reads
+:meth:`snapshot` while the chunk loop calls :meth:`update`.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from . import metrics as _metrics
+
+__all__ = ["OK", "DEGRADED", "CRITICAL", "HealthEngine"]
+
+OK = "OK"
+DEGRADED = "DEGRADED"
+CRITICAL = "CRITICAL"
+
+#: severity order for folding conditions into one verdict
+_RANK = {OK: 0, DEGRADED: 1, CRITICAL: 2}
+
+
+class _Condition:
+    __slots__ = ("kind", "severity", "detail", "ttl", "sticky")
+
+    def __init__(self, kind, severity, detail, ttl, sticky):
+        self.kind = kind
+        self.severity = severity
+        self.detail = detail
+        self.ttl = ttl
+        self.sticky = sticky
+
+
+class HealthEngine:
+    """Fold per-chunk telemetry into an OK/DEGRADED/CRITICAL verdict.
+
+    Call :meth:`update` once per chunk (the drivers do this when an
+    engine is wired in); read :meth:`verdict` / :meth:`snapshot` from
+    anywhere.  All thresholds are constructor knobs with deliberately
+    conservative defaults — the engine flags *kinds* of trouble (3x
+    wall, order-of-magnitude candidate spikes), not scheduler noise.
+    """
+
+    def __init__(self, *, wall_factor=3.0, ewma_alpha=0.3, warmup=2,
+                 cand_factor=8.0, cand_abs_min=16, storm_critical_after=3,
+                 headroom_degraded=0.10, headroom_critical=0.03,
+                 retrace_budget=3, retry_budget=3, quarantine_critical=3,
+                 recall_floor=0.7, recall_min_injected=10,
+                 recall_window=20, recover_after=2, max_incidents=200):
+        self.wall_factor = float(wall_factor)
+        self.ewma_alpha = float(ewma_alpha)
+        self.warmup = int(warmup)
+        self.cand_factor = float(cand_factor)
+        self.cand_abs_min = int(cand_abs_min)
+        self.storm_critical_after = int(storm_critical_after)
+        self.headroom_degraded = float(headroom_degraded)
+        self.headroom_critical = float(headroom_critical)
+        self.retrace_budget = int(retrace_budget)
+        self.retry_budget = int(retry_budget)
+        self.quarantine_critical = int(quarantine_critical)
+        self.recall_floor = float(recall_floor)
+        self.recall_min_injected = int(recall_min_injected)
+        self.recall_window = int(recall_window)
+        self.recover_after = int(recover_after)
+
+        self._lock = threading.Lock()
+        self._active = {}           # kind -> _Condition
+        self._incidents = collections.deque(maxlen=max_incidents)
+        self.transitions = []       # (chunk, from, to, reasons)
+        self._verdict = OK
+        self._updates = 0
+        self._wall_ewma = None
+        self._cand_ewma = None
+        self._storm_run = 0
+        self._retraces = 0
+        self._retries = 0
+        self._quarantined = 0
+
+    # -- condition plumbing --------------------------------------------------
+
+    def _raise(self, chunk, kind, severity, detail, sticky=False):
+        cond = self._active.get(kind)
+        if cond is None or _RANK[severity] > _RANK[cond.severity]:
+            self._incidents.append({
+                "chunk": chunk, "kind": kind, "severity": severity,
+                "event": "raised", "detail": detail,
+                "t": round(time.time(), 3)})
+            _metrics.counter("putpu_health_incidents_total",
+                             kind=kind).inc()
+        if cond is None:
+            self._active[kind] = _Condition(kind, severity, detail,
+                                            self.recover_after, sticky)
+        else:
+            if _RANK[severity] > _RANK[cond.severity]:
+                cond.severity = severity
+            cond.detail = detail
+            cond.ttl = self.recover_after
+            cond.sticky = cond.sticky or sticky
+
+    def _decay(self, chunk, raised):
+        for kind in list(self._active):
+            cond = self._active[kind]
+            if kind in raised or cond.sticky:
+                continue
+            cond.ttl -= 1
+            if cond.ttl <= 0:
+                del self._active[kind]
+                self._incidents.append({
+                    "chunk": chunk, "kind": kind,
+                    "severity": cond.severity, "event": "resolved",
+                    "detail": cond.detail, "t": round(time.time(), 3)})
+
+    def _refold(self, chunk):
+        new = OK
+        for cond in self._active.values():
+            if _RANK[cond.severity] > _RANK[new]:
+                new = cond.severity
+        if new != self._verdict:
+            self.transitions.append(
+                {"chunk": chunk, "from": self._verdict, "to": new,
+                 "reasons": sorted(self._active)})
+            self._verdict = new
+        _metrics.gauge("putpu_health_status").set(_RANK[new])
+
+    # -- the per-chunk update ------------------------------------------------
+
+    def update(self, chunk, *, wall_s=None, candidates=None,
+               quarantined=False, dead_letter=False, retraces=0,
+               dispatch_retries=0, headroom_frac=None, fallback=False,
+               canary=None):
+        """Fold one chunk's telemetry in; returns the verdict after it.
+
+        ``candidates`` is the number of table rows above the hit
+        threshold (the RFI-storm signal — NOT the 0/1 hit decision);
+        ``headroom_frac`` is free-device-memory / limit when known;
+        ``canary`` is the controller's :meth:`~.canary.CanaryController.
+        summary` dict (``injected`` + ``window_recall`` are consumed).
+        """
+        with self._lock:
+            self._updates += 1
+            raised = set()
+
+            def flag(kind, severity, detail, sticky=False):
+                raised.add(kind)
+                self._raise(chunk, kind, severity, detail, sticky)
+
+            if wall_s is not None:
+                wall_s = float(wall_s)
+                if self._wall_ewma is not None \
+                        and self._updates > self.warmup \
+                        and wall_s > self.wall_factor * self._wall_ewma \
+                        + 0.05:
+                    flag("slow_chunk", DEGRADED,
+                         f"chunk wall {wall_s:.2f}s vs EWMA baseline "
+                         f"{self._wall_ewma:.2f}s "
+                         f"(factor {self.wall_factor:g})")
+                else:
+                    # spikes are excluded from the baseline on purpose:
+                    # a storm of slow chunks must not drag the baseline
+                    # up until the storm looks normal
+                    self._wall_ewma = (wall_s if self._wall_ewma is None
+                                       else (1 - self.ewma_alpha)
+                                       * self._wall_ewma
+                                       + self.ewma_alpha * wall_s)
+
+            if candidates is not None:
+                candidates = int(candidates)
+                baseline = self._cand_ewma if self._cand_ewma is not None \
+                    else 0.0
+                ceiling = max(self.cand_abs_min,
+                              self.cand_factor * (baseline + 1.0))
+                if self._updates > self.warmup and candidates > ceiling:
+                    self._storm_run += 1
+                    sev = (CRITICAL
+                           if self._storm_run >= self.storm_critical_after
+                           else DEGRADED)
+                    flag("candidate_storm", sev,
+                         f"{candidates} candidates in one chunk vs "
+                         f"baseline {baseline:.1f} (RFI storm signature; "
+                         f"{self._storm_run} consecutive)")
+                else:
+                    self._storm_run = 0
+                    self._cand_ewma = (float(candidates)
+                                       if self._cand_ewma is None
+                                       else (1 - self.ewma_alpha)
+                                       * self._cand_ewma
+                                       + self.ewma_alpha * candidates)
+
+            if quarantined:
+                self._quarantined += 1
+                sev = (CRITICAL
+                       if self._quarantined >= self.quarantine_critical
+                       else DEGRADED)
+                flag("quarantine", sev,
+                     f"chunk {chunk} quarantined "
+                     f"({self._quarantined} so far)")
+            if dead_letter:
+                flag("persist_dead_letter", DEGRADED,
+                     f"chunk {chunk} persisted to the dead-letter "
+                     "manifest (candidate missing on purpose)")
+            if retraces:
+                self._retraces += int(retraces)
+                if self._retraces >= self.retrace_budget:
+                    flag("retrace_storm", DEGRADED,
+                         f"{self._retraces} retraces (shape drift? "
+                         "interior chunks should reuse one executable)")
+            if dispatch_retries:
+                self._retries += int(dispatch_retries)
+                if self._retries >= self.retry_budget:
+                    flag("dispatch_retries", DEGRADED,
+                         f"{self._retries} dispatch retries "
+                         "(flaky device/link)")
+            if fallback:
+                flag("numpy_fallback", DEGRADED,
+                     "device search fell back to the numpy reference "
+                     "path permanently (reference speed)", sticky=True)
+
+            if headroom_frac is not None:
+                headroom_frac = float(headroom_frac)
+                if headroom_frac < self.headroom_critical:
+                    flag("device_headroom", CRITICAL,
+                         f"device headroom {100 * headroom_frac:.1f}% "
+                         "(next chunk is an OOM away)")
+                elif headroom_frac < self.headroom_degraded:
+                    flag("device_headroom", DEGRADED,
+                         f"device headroom {100 * headroom_frac:.1f}%")
+
+            if canary and canary.get("injected", 0) \
+                    >= self.recall_min_injected:
+                recall = canary.get("window_recall")
+                if recall is not None and recall < self.recall_floor:
+                    flag("canary_recall", CRITICAL,
+                         f"canary recall {recall:.2f} over the last "
+                         f"{canary.get('window', self.recall_window)} "
+                         f"injections is below the {self.recall_floor:g} "
+                         "floor — detection efficiency is degrading "
+                         "while perf counters may still be green")
+
+            self._decay(chunk, raised)
+            self._refold(chunk)
+            return self._verdict
+
+    # -- read side -----------------------------------------------------------
+
+    @property
+    def verdict(self):
+        with self._lock:
+            return self._verdict
+
+    def reasons(self):
+        """Active condition kinds, worst first."""
+        with self._lock:
+            return [c.kind for c in sorted(
+                self._active.values(),
+                key=lambda c: (-_RANK[c.severity], c.kind))]
+
+    def snapshot(self, max_incidents=50):
+        """JSON-ready state for ``/healthz`` and the survey report."""
+        with self._lock:
+            return {
+                "status": self._verdict,
+                "reasons": [
+                    {"kind": c.kind, "severity": c.severity,
+                     "detail": c.detail}
+                    for c in sorted(self._active.values(),
+                                    key=lambda c: (-_RANK[c.severity],
+                                                   c.kind))],
+                "updates": self._updates,
+                "incidents": list(self._incidents)[-max_incidents:],
+                "transitions": list(self.transitions),
+            }

@@ -30,6 +30,29 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
 DEFAULT_EDGES = (0.001, 0.01, 0.1, 1.0, 10.0, 100.0)
 
 
+def _escape_label_value(v):
+    """Prometheus exposition-format label-value escaping: backslash,
+    double-quote and newline (in that order — escaping the backslash
+    first keeps the other two escapes unambiguous)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text):
+    """HELP text escaping per the exposition format: backslash and
+    newline only (quotes are legal in HELP)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_labels(items):
+    """``{...}`` label block from sorted ``(key, value)`` pairs, with
+    conformant value escaping; empty string for no labels."""
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
+    return "{" + inner + "}"
+
+
 class _Instrument:
     __slots__ = ("name", "help", "labels", "_lock")
 
@@ -40,10 +63,7 @@ class _Instrument:
         self._lock = threading.Lock()
 
     def _label_str(self):
-        if not self.labels:
-            return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in self.labels)
-        return "{" + inner + "}"
+        return _fmt_labels(self.labels)
 
 
 class Counter(_Instrument):
@@ -147,19 +167,21 @@ class Histogram(_Instrument):
                 "sum": round(total, 6), "count": n}
 
     def _prom_lines(self):
+        # conformance contract (pinned by a golden-text test):
+        # cumulative ``_bucket`` samples, one per edge plus a final
+        # ``le="+Inf"`` equal to ``_count``, then ``_sum``/``_count`` —
+        # label values escaped like every other sample line
         s = self._sample()
         lab = dict(self.labels)
         out = []
         cum = 0
         for e, c in zip(s["edges"], s["counts"]):
             cum += c
-            inner = ",".join(f'{k}="{v}"' for k, v in
-                             sorted({**lab, "le": repr(e)}.items()))
-            out.append(f"{self.name}_bucket{{{inner}}} {cum}")
+            inner = _fmt_labels(sorted({**lab, "le": repr(e)}.items()))
+            out.append(f"{self.name}_bucket{inner} {cum}")
         cum += s["counts"][-1]
-        inner = ",".join(f'{k}="{v}"' for k, v in
-                         sorted({**lab, "le": "+Inf"}.items()))
-        out.append(f"{self.name}_bucket{{{inner}}} {cum}")
+        inner = _fmt_labels(sorted({**lab, "le": "+Inf"}.items()))
+        out.append(f"{self.name}_bucket{inner} {cum}")
         base = self._label_str()
         out.append(f"{self.name}_sum{base} {s['sum']}")
         out.append(f"{self.name}_count{base} {s['count']}")
@@ -212,9 +234,15 @@ class MetricsRegistry:
                         "labels": dict(labels), **m._sample()})
         return out
 
-    def write_jsonl(self, path):
+    def write_jsonl(self, path, schema_version=None):
+        """JSONL export; ``schema_version`` (when given) is written as a
+        ``{"schema_version": N}`` header line so downstream consumers
+        (:mod:`.gate`) can refuse to parse drifted snapshots."""
         snap = self.snapshot()
         with open(path, "w") as f:
+            if schema_version is not None:
+                f.write(json.dumps({"schema_version": schema_version})
+                        + "\n")
             for rec in snap:
                 f.write(json.dumps(rec) + "\n")
         return len(snap)
@@ -226,7 +254,7 @@ class MetricsRegistry:
             if name not in seen_header:
                 seen_header.add(name)
                 if m.help:
-                    lines.append(f"# HELP {name} {m.help}")
+                    lines.append(f"# HELP {name} {_escape_help(m.help)}")
                 lines.append(f"# TYPE {name} {m.kind}")
             lines.extend(m._prom_lines())
         return "\n".join(lines) + "\n"

@@ -1,0 +1,367 @@
+"""End-of-run survey report: one self-contained artifact per run.
+
+A multi-hour survey leaves its evidence scattered across the log (the
+``BUDGET_JSON`` footer, sift lines), the metrics snapshot, the
+quarantine manifest and — this PR — the canary ledger and health
+incident log.  :func:`write_report` stitches them into **one markdown
+file and one dependency-free single-file HTML page** (inline CSS, an
+inline SVG recall sparkline, zero external assets — it survives being
+scp'd out of a dying preemptible VM on its own), plus the
+machine-readable ``.json`` record that :func:`amend_report` re-renders
+from (the CLI folds post-run sift telemetry in this way):
+
+* run header: file, fingerprint, chunks/hits/certified, wall;
+* health: final verdict, verdict transitions, incident log;
+* canary: injected/recovered/recall, S/N recovery ratio, DM error,
+  and the recall-vs-chunk curve;
+* budget: per-bucket seconds + share, attributed %, trips x RTT;
+* roofline: the per-kernel table when accounting ran;
+* sift + quarantine: telemetry counters and the manifest records.
+
+Every section is optional — pass what the run produced; the report says
+explicitly when a section has no data (absence of evidence, stated).
+"""
+
+from __future__ import annotations
+
+import html as _html
+import json
+import time
+
+__all__ = ["amend_report", "build_report", "write_report",
+           "render_markdown", "render_html"]
+
+
+def _fmt(v, nd=3):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def build_report(*, meta=None, budget=None, roofline=None, health=None,
+                 canary=None, quarantine=None, sift=None, metrics=None):
+    """Assemble the structured report record (JSON-ready).
+
+    ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
+    ``roofline``: ``obs.roofline.table()`` rows; ``health``:
+    ``HealthEngine.snapshot()``; ``canary``:
+    ``CanaryController.to_json()``; ``quarantine``:
+    ``QuarantineManifest.records()``; ``sift``: the ``SIFT_JSON`` stats
+    dict; ``metrics``: a registry snapshot list (key totals are pulled
+    out for the header).
+    """
+    rec = {
+        "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "meta": dict(meta or {}),
+        "budget": budget,
+        "roofline": roofline or [],
+        "health": health,
+        "canary": canary,
+        "quarantine": quarantine or [],
+        "sift": sift,
+    }
+    if metrics:
+        totals = {}
+        for m in metrics:
+            if m.get("type") == "counter" and not m.get("labels"):
+                totals[m["name"]] = m.get("value")
+        rec["counters"] = {k: totals[k] for k in sorted(totals)}
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# markdown
+# ---------------------------------------------------------------------------
+
+def _md_table(headers, rows):
+    out = ["| " + " | ".join(headers) + " |",
+           "| " + " | ".join("---" for _ in headers) + " |"]
+    for r in rows:
+        out.append("| " + " | ".join(str(c) for c in r) + " |")
+    return "\n".join(out)
+
+
+def render_markdown(rec):
+    meta = rec["meta"]
+    lines = [f"# Survey report — {meta.get('root', meta.get('fname', 'run'))}",
+             "",
+             f"Generated {rec['generated']}.", ""]
+    header_rows = [(k, _fmt(v)) for k, v in meta.items()]
+    if header_rows:
+        lines += [_md_table(("key", "value"), header_rows), ""]
+
+    lines.append("## Health")
+    lines.append("")
+    health = rec.get("health")
+    if health:
+        lines.append(f"Final verdict: **{health['status']}**"
+                     + (f" ({', '.join(r['kind'] for r in health['reasons'])})"
+                        if health.get("reasons") else "") + ".")
+        lines.append("")
+        if health.get("transitions"):
+            lines.append(_md_table(
+                ("chunk", "from", "to", "reasons"),
+                [(t["chunk"], t["from"], t["to"], ", ".join(t["reasons"]))
+                 for t in health["transitions"]]))
+        else:
+            lines.append("No verdict transitions: the run stayed "
+                         f"{health['status']} throughout.")
+        lines.append("")
+        if health.get("incidents"):
+            lines.append(_md_table(
+                ("chunk", "kind", "severity", "event", "detail"),
+                [(i["chunk"], i["kind"], i["severity"], i["event"],
+                  i["detail"]) for i in health["incidents"]]))
+            lines.append("")
+    else:
+        lines += ["No health engine was wired into this run.", ""]
+
+    lines.append("## Canary injection-recovery")
+    lines.append("")
+    canary = rec.get("canary")
+    if canary and canary.get("injected"):
+        lines.append(
+            f"Injected **{canary['injected']}** synthetic pulses "
+            f"(DM {_fmt(canary['dm'], 2)}, target S/N "
+            f"{_fmt(canary['target_snr'], 1)}, width "
+            f"{canary['width_samples']} samples, rate "
+            f"{canary['rate']:g}); recovered {canary['recovered']} — "
+            f"**recall {_fmt(canary['recall'], 4)}** (last-"
+            f"{canary['window']} window: "
+            f"{_fmt(canary['window_recall'], 4)}).")
+        lines.append("")
+        lines.append(_md_table(
+            ("S/N recovery ratio (mean)", "DM error mean", "DM error rms",
+             "discarded (never searched)"),
+            [(_fmt(canary.get("snr_ratio_mean"), 4),
+              _fmt(canary.get("dm_error_mean"), 4),
+              _fmt(canary.get("dm_error_rms"), 4),
+              canary.get("discarded", 0))]))
+        lines.append("")
+        if canary.get("curve"):
+            pts = canary["curve"]
+            step = max(1, len(pts) // 20)
+            lines.append("Cumulative recall curve (chunk, injected, "
+                         "recall):")
+            lines.append("")
+            lines.append(_md_table(("chunk", "injected", "recall"),
+                                   pts[::step]))
+            lines.append("")
+    else:
+        lines += ["Canary injection was off (or no canary reached the "
+                  "search): recall was NOT measured for this run.", ""]
+
+    lines.append("## Wall-clock budget")
+    lines.append("")
+    budget = rec.get("budget")
+    if budget:
+        wall = budget.get("wall_s") or 0.0
+        lines.append(
+            f"{budget.get('chunks', 0)} chunks, {_fmt(wall, 2)}s summed "
+            f"chunk wall, {_fmt(budget.get('attributed_pct'), 1)}% "
+            "attributed.")
+        lines.append("")
+        rows = [(k, _fmt(v), f"{100.0 * v / wall:.1f}%" if wall else "-")
+                for k, v in (budget.get("buckets_s") or {}).items()]
+        rows.append(("unattributed", _fmt(budget.get("unattributed_s")),
+                     f"{100.0 * budget.get('unattributed_s', 0) / wall:.1f}%"
+                     if wall else "-"))
+        lines.append(_md_table(("bucket", "seconds", "share"), rows))
+        lines.append("")
+        if budget.get("rtt_s") is not None:
+            lines.append(f"Device RTT {_fmt(budget['rtt_s'], 6)}s x "
+                         f"{budget.get('trips')} trips = "
+                         f"{_fmt(budget.get('trips_x_rtt_s'))}s floor.")
+            lines.append("")
+        if budget.get("counters"):
+            lines.append("Counters: `"
+                         + json.dumps(budget["counters"]) + "`")
+            lines.append("")
+    else:
+        lines += ["No budget ledger for this run.", ""]
+
+    lines.append("## Roofline")
+    lines.append("")
+    if rec.get("roofline"):
+        lines.append(_md_table(
+            ("kernel", "calls", "wall s", "GF/s", "GB/s", "ideal"),
+            [(r["kernel"], r["calls"], _fmt(r["wall_s"]),
+              _fmt(r["achieved_gflops"], 2),
+              _fmt(r["achieved_gbytes_per_s"], 2),
+              "-" if r["frac_of_ideal"] is None
+              else f"{100 * r['frac_of_ideal']:.1f}%")
+             for r in rec["roofline"]]))
+        lines.append("")
+    else:
+        lines += ["Roofline accounting did not run (enable with "
+                  "`--trace` or `PUTPU_ROOFLINE=1`).", ""]
+
+    lines.append("## Sift")
+    lines.append("")
+    sift = rec.get("sift")
+    if sift:
+        lines.append(f"{sift.get('in')} candidates in, "
+                     f"{sift.get('kept')} kept; rejected: `"
+                     + json.dumps(sift.get("rejected", {})) + "`")
+    else:
+        lines.append("No sift telemetry (single-candidate run or sift "
+                     "skipped).")
+    lines.append("")
+
+    lines.append("## Quarantine manifest")
+    lines.append("")
+    if rec.get("quarantine"):
+        lines.append(_md_table(
+            ("chunk", "end", "reason"),
+            [(q["chunk"], q["end"], q["reason"])
+             for q in rec["quarantine"]]))
+    else:
+        lines.append("No chunks were quarantined.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# single-file HTML
+# ---------------------------------------------------------------------------
+
+_CSS = """
+body{font:14px/1.5 system-ui,sans-serif;max-width:60rem;margin:2rem auto;
+padding:0 1rem;color:#1a1a2e}
+h1{border-bottom:2px solid #ddd;padding-bottom:.3rem}
+h2{margin-top:2rem;color:#16324f}
+table{border-collapse:collapse;margin:.6rem 0}
+th,td{border:1px solid #ccc;padding:.25rem .6rem;text-align:left}
+th{background:#f0f3f7}
+code{background:#f4f4f4;padding:.1rem .3rem;border-radius:3px}
+.verdict-OK{color:#1b7f3b;font-weight:700}
+.verdict-DEGRADED{color:#b07d00;font-weight:700}
+.verdict-CRITICAL{color:#b00020;font-weight:700}
+"""
+
+
+def _html_table(headers, rows):
+    head = "".join(f"<th>{_html.escape(str(h))}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{_html.escape(str(c))}</td>" for c in r)
+        + "</tr>" for r in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _recall_svg(curve, width=480, height=80):
+    """Inline SVG sparkline of cumulative recall vs injection index."""
+    if len(curve) < 2:
+        return ""
+    n = len(curve)
+    xs = [i * (width - 10) / (n - 1) + 5 for i in range(n)]
+    ys = [height - 8 - p[2] * (height - 16) for p in curve]
+    pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in zip(xs, ys))
+    return (f'<svg width="{width}" height="{height}" '
+            'role="img" aria-label="cumulative canary recall">'
+            f'<line x1="5" y1="{height - 8}" x2="{width - 5}" '
+            f'y2="{height - 8}" stroke="#ccc"/>'
+            f'<polyline points="{pts}" fill="none" stroke="#16324f" '
+            'stroke-width="1.5"/></svg>')
+
+
+def render_html(rec):
+    md = render_markdown(rec)  # single source of section content
+    # translate the markdown we just generated ourselves (headings,
+    # tables, paragraphs, bold, code) — a bounded dialect, not a
+    # general converter
+    out = []
+    lines = md.split("\n")
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("| ") and i + 1 < len(lines) \
+                and set(lines[i + 1].replace(" ", "")) <= {"|", "-"}:
+            headers = [c.strip() for c in line.strip("|").split("|")]
+            rows = []
+            i += 2
+            while i < len(lines) and lines[i].startswith("|"):
+                rows.append([c.strip() for c in
+                             lines[i].strip("|").split("|")])
+                i += 1
+            out.append(_html_table(headers, rows))
+            continue
+        if line.startswith("# "):
+            out.append(f"<h1>{_html.escape(line[2:])}</h1>")
+        elif line.startswith("## "):
+            out.append(f"<h2>{_html.escape(line[3:])}</h2>")
+        elif line.strip():
+            text = _html.escape(line)
+            while "**" in text:
+                text = text.replace("**", "<strong>", 1)
+                text = text.replace("**", "</strong>", 1)
+            while "`" in text:
+                text = text.replace("`", "<code>", 1)
+                text = text.replace("`", "</code>", 1)
+            health = rec.get("health")
+            if health and text.startswith("Final verdict:"):
+                v = health["status"]
+                text = text.replace(
+                    f"<strong>{v}</strong>",
+                    f'<span class="verdict-{v}">{v}</span>')
+            out.append(f"<p>{text}</p>")
+        i += 1
+        # the recall sparkline rides directly under the canary heading
+        if line == "## Canary injection-recovery" \
+                and rec.get("canary", {}) \
+                and (rec["canary"] or {}).get("curve"):
+            out.append(_recall_svg(rec["canary"]["curve"]))
+    title = _html.escape(str(rec["meta"].get(
+        "root", rec["meta"].get("fname", "survey report"))))
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>Survey report — {title}</title>"
+            f"<style>{_CSS}</style></head><body>"
+            + "\n".join(out) + "</body></html>\n")
+
+
+def _strip_ext(out_base):
+    for ext in (".md", ".html", ".htm", ".json"):
+        if out_base.endswith(ext):
+            return out_base[: -len(ext)]
+    return out_base
+
+
+def _render_all(out_base, rec):
+    md_path, html_path = out_base + ".md", out_base + ".html"
+    with open(md_path, "w") as f:
+        f.write(render_markdown(rec))
+    with open(html_path, "w") as f:
+        f.write(render_html(rec))
+    # the machine-readable record rides along: artifact parsers get
+    # the sections as data, and :func:`amend_report` re-renders from it
+    with open(out_base + ".json", "w") as f:
+        json.dump(rec, f, indent=1)
+    return md_path, html_path
+
+
+def write_report(out_base, **sections):
+    """Write ``<out_base>.md``, a self-contained ``<out_base>.html``
+    and the machine-readable ``<out_base>.json`` record (a trailing
+    ``.md``/``.html``/``.htm``/``.json`` on ``out_base`` is stripped
+    first).  Accepts :func:`build_report`'s keyword sections; returns
+    the markdown and HTML paths."""
+    out_base = _strip_ext(out_base)
+    return _render_all(out_base, build_report(**sections))
+
+
+def amend_report(out_base, **sections):
+    """Merge ``sections`` into an already-written report and re-render
+    all three files.  The driver writes the report before the CLI runs
+    sift, so the CLI folds the sift telemetry in afterwards with
+    ``amend_report(path, sift=stats)``; any :func:`build_report`
+    section can be amended the same way."""
+    out_base = _strip_ext(out_base)
+    with open(out_base + ".json") as f:
+        rec = json.load(f)
+    for key, value in sections.items():
+        if key == "meta":
+            rec.setdefault("meta", {}).update(value or {})
+        else:
+            rec[key] = value
+    return _render_all(out_base, rec)

@@ -1,0 +1,152 @@
+"""Live HTTP surface: ``/metrics``, ``/healthz``, ``/progress``.
+
+The textfile exporter (:meth:`..obs.metrics.MetricsRegistry.
+write_prometheus`) only tells the truth as of the last write; a
+multi-hour survey on a preemptible fleet needs to be scrapeable *while
+it runs*.  This module serves three read-only endpoints from a stdlib
+``ThreadingHTTPServer`` on a daemon thread — no new dependencies, no
+effect on the chunk loop beyond the registry locks a scrape already
+takes:
+
+* ``/metrics`` — the live Prometheus text exposition of the process
+  registry (complementing, not replacing, the textfile route);
+* ``/healthz`` — the :class:`~.health.HealthEngine` verdict + active
+  reasons as JSON; HTTP **503 on CRITICAL** so a dumb probe (a fleet
+  scheduler's TCP check, ``curl -f``) needs zero parsing to act;
+* ``/progress`` — chunks done/total, ETA, hit/certified/quarantine
+  counts and the live canary summary as JSON.
+
+Start with :func:`start_obs_server` (``port=0`` binds an ephemeral port
+— tests use this), stop via the returned handle's ``close()``.  The
+drivers own the lifecycle behind their ``http_port=`` knob; a server
+failure at bind time propagates (an operator who asked for the surface
+must not silently fly blind), but request handling never raises into
+the survey.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.logging_utils import logger
+from . import metrics as _metrics
+
+__all__ = ["ObsServer", "start_obs_server"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    #: quiet by default: per-scrape request logging at 10s Prometheus
+    #: intervals would drown the survey log
+    def log_message(self, fmt, *args):
+        logger.debug("obs.server: " + fmt, *args)
+
+    def _send(self, status, body, content_type):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    def do_HEAD(self):  # noqa: N802 — http.server API
+        self.do_GET()
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        srv = self.server.obs  # type: ignore[attr-defined]
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/metrics":
+                self._send(200, srv.registry.prometheus_text(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                doc = srv.health_snapshot()
+                status = 503 if doc["status"] == "CRITICAL" else 200
+                self._send(status, json.dumps(doc, indent=1),
+                           "application/json")
+            elif path == "/progress":
+                self._send(200, json.dumps(srv.progress_snapshot(),
+                                           indent=1), "application/json")
+            elif path == "/":
+                self._send(200, "pulsarutils_tpu live survey surface: "
+                           "/metrics /healthz /progress\n", "text/plain")
+            else:
+                self._send(404, "not found\n", "text/plain")
+        except Exception as exc:  # a scrape must never kill the survey
+            try:
+                self._send(500, f"internal error: {exc!r}\n", "text/plain")
+            except Exception:
+                pass
+
+
+class ObsServer:
+    """The live surface around a running survey.
+
+    ``health`` is a :class:`~.health.HealthEngine` (or ``None`` — then
+    ``/healthz`` reports ``OK`` with a note that no engine is wired);
+    ``progress_fn`` is a zero-arg callable returning the ``/progress``
+    dict (the drivers pass a closure over their loop state — reads of
+    ints/lists under the GIL, no locking needed on the writer side).
+    """
+
+    def __init__(self, port=0, health=None, progress_fn=None,
+                 registry=None, host="127.0.0.1"):
+        self.health = health
+        self.progress_fn = progress_fn
+        self.registry = registry if registry is not None \
+            else _metrics.REGISTRY
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.obs = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-http",
+            daemon=True)
+        self._thread.start()
+        logger.info("live survey surface on http://%s:%d "
+                    "(/metrics /healthz /progress)", host, self.port)
+
+    def health_snapshot(self):
+        if self.health is None:
+            return {"status": "OK", "reasons": [],
+                    "note": "no health engine wired"}
+        return self.health.snapshot()
+
+    def progress_snapshot(self):
+        doc = {}
+        if self.progress_fn is not None:
+            try:
+                doc = dict(self.progress_fn())
+            except Exception as exc:
+                doc = {"error": repr(exc)}
+        doc.setdefault("status", self.health.verdict
+                       if self.health is not None else "OK")
+        return doc
+
+    def close(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def start_obs_server(port, health=None, progress_fn=None, registry=None,
+                     host="127.0.0.1"):
+    """Start the live surface; returns the :class:`ObsServer` handle
+    (``handle.port`` holds the bound port — pass ``port=0`` for an
+    ephemeral one).  ``host`` is the bind address: the loopback default
+    keeps the surface private to the machine; pass ``"0.0.0.0"`` (or a
+    specific interface) so a remote Prometheus scrape job or a fleet
+    scheduler's ``/healthz`` probe can reach it."""
+    return ObsServer(port=port, health=health, progress_fn=progress_fn,
+                     registry=registry, host=host)

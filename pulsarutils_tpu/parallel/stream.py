@@ -100,7 +100,8 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   *, backend="jax", snr_threshold=6.0, trial_dms=None,
                   dm_block=None, chan_block=None, budget=None, mesh=None,
                   kernel="auto", dispatch_timeout=None, dispatch_retries=0,
-                  skip_failed=False):
+                  skip_failed=False, health=None, http_port=None,
+                  http_host="127.0.0.1", canary=None):
     """Search an iterable of ``(istart, (nchan, step))`` chunks.
 
     One compiled executable serves every distinct chunk shape; interior
@@ -140,12 +141,34 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     would fail identically on every chunk), so a producer feeding
     malformed per-chunk arrays must validate shapes upstream rather
     than rely on containment.
+
+    Live surface (ISSUE 5, same contract as ``search_by_chunks``):
+    ``http_port`` serves ``/metrics`` / ``/healthz`` / ``/progress``
+    for the duration of the stream (``http_host`` picks the bind
+    address — loopback by default, ``"0.0.0.0"`` to let a remote
+    Prometheus scrape job or fleet probe reach it); ``health`` accepts
+    a caller-owned
+    :class:`~pulsarutils_tpu.obs.health.HealthEngine` (created
+    internally when ``http_port`` is set), updated per chunk with wall
+    time, candidate rate and containment events; ``canary`` (a
+    :class:`~pulsarutils_tpu.obs.canary.CanaryController` or a bare
+    rate float) injects synthetic pulses into selected chunks before
+    the search and matches them against the emitted tables — canary
+    best rows are excluded from the returned ``hits``, and when the
+    canary outranks a genuine weaker pulse in the same chunk that
+    pulse's row is promoted as the chunk's ``best_row`` instead.  All
+    are ``None``-gated: off means the pre-PR code path,
+    byte-identical.
     """
     import contextlib
+    import time as _time
 
     from ..faults import inject as fault_inject
     from ..faults.policy import call_with_deadline
     from ..obs import metrics as _metrics
+    from ..obs.canary import CanaryController
+    from ..obs.health import HealthEngine
+    from ..obs.server import start_obs_server
     from ..obs.trace import set_track, span
     from ..utils.logging_utils import logger
 
@@ -205,15 +228,59 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                                else "giving up")
         raise last
 
+    if canary is not None and not isinstance(canary, CanaryController):
+        canary = CanaryController(rate=float(canary))
+    if canary is not None and canary.rate <= 0.0:
+        canary = None
+    if http_port is not None and health is None:
+        health = HealthEngine()
+
     results = []
     hits = []
-    for istart, chunk in chunks:
+    total = len(chunks) if hasattr(chunks, "__len__") else None
+    t_run0 = _time.time()
+
+    def _progress_snapshot():
+        done = len(results)
+        elapsed = _time.time() - t_run0
+        rate = done / elapsed if elapsed > 0 and done else None
+        doc = {"chunks_done": done, "chunks_total": total,
+               "elapsed_s": round(elapsed, 1),
+               "eta_s": (round((total - done) / rate, 1)
+                         if rate and total is not None else None),
+               "hits": len(hits)}
+        if canary is not None:
+            doc["canary"] = canary.summary()
+        return doc
+
+    obs_server = (start_obs_server(http_port, health=health,
+                                   progress_fn=_progress_snapshot,
+                                   host=http_host)
+                  if http_port is not None else None)
+
+    def _health_update(istart, wall_s, candidates=None, contained=False):
+        if health is not None:
+            health.update(istart, wall_s=wall_s, candidates=candidates,
+                          quarantined=contained,
+                          canary=canary.summary()
+                          if canary is not None else None)
+
+    try:
+      for istart, chunk in chunks:
         # with a budget, the chunk/search spans come from the accountant
         # itself (one timing primitive); without one, emit them directly
         # so a trace-only stream still renders per-chunk tracks
         ctx = (budget.chunk(istart) if budget is not None
                else traced_chunk(istart))
         with ctx:
+            t_chunk = _time.perf_counter()
+            if canary is not None:
+                if not canary._bound:
+                    canary.bind(nchan=chunk.shape[0],
+                                start_freq=start_freq,
+                                bandwidth=bandwidth, tsamp=sample_time,
+                                dmmin=dmmin, dmmax=dmmax)
+                chunk = canary.maybe_inject(chunk, istart)
             try:
                 with (budget.bucket("search") if budget is not None
                       else span("search")):
@@ -227,13 +294,74 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                 # stream — counted, logged above, and absent from the
                 # results (callers see exactly which chunks made it)
                 _metrics.counter("putpu_stream_chunks_failed_total").inc()
+                if canary is not None:
+                    canary.discard(istart)
+                _health_update(istart,
+                               wall_s=_time.perf_counter() - t_chunk,
+                               contained=True)
                 continue
+            canary_obs = (canary.observe(istart, table, snr_threshold)
+                          if canary is not None else None)
             results.append((istart, table))
             best = table.best_row()
             _metrics.counter("putpu_stream_chunks_total").inc()
             if best["snr"] > snr_threshold:
-                hits.append((istart, table, best))
-                _metrics.counter("putpu_stream_hits_total").inc()
+                if canary_obs is not None \
+                        and canary_obs["best_is_canary"]:
+                    # the chunk's best row is the injected canary:
+                    # excluded from the science hits.  A genuine weaker
+                    # pulse in the same chunk is promoted in its place
+                    # — the hit list must match the canary-off run's
+                    canary.tag_hit(istart)
+                    sci_idx = canary_obs["science_idx"]
+                    sci_snr = canary_obs["science_snr"]
+                    if sci_idx is not None \
+                            and sci_snr > float(snr_threshold):
+                        # same contract as search_by_chunks: the
+                        # promoted hit's table has the canary-lit rows
+                        # masked out, so consumers sifting/persisting
+                        # stream hits never ingest synthetic rows
+                        keep = ~canary_obs["canary_rows"]
+                        sci_table = type(table)(
+                            {name: table[name][keep]
+                             for name in table.colnames},
+                            meta=table.meta)
+                        best = {name: table[name][sci_idx]
+                                for name in table.colnames}
+                        hits.append((istart, sci_table, best))
+                        _metrics.counter(
+                            "putpu_stream_hits_total").inc()
+                        _metrics.counter(
+                            "putpu_canary_promoted_hits_total").inc()
+                else:
+                    if canary_obs is not None \
+                            and canary_obs["recovered"]:
+                        # a real pulse outranked this chunk's canary:
+                        # the hit is genuine but its table still holds
+                        # the canary-lit rows — counted + logged, as in
+                        # search_by_chunks
+                        _metrics.counter(
+                            "putpu_canary_contaminated_tables_total").inc()
+                        logger.info(
+                            "stream chunk %d: real hit persisted "
+                            "alongside a recovered canary — trial rows "
+                            "near DM %.1f include synthetic signal",
+                            istart, canary.dm)
+                    hits.append((istart, table, best))
+                    _metrics.counter("putpu_stream_hits_total").inc()
+            if health is not None:
+                ncand = int(np.count_nonzero(
+                    np.asarray(table["snr"], dtype=np.float64)
+                    > float(snr_threshold)))
+                if canary_obs is not None:
+                    # canary-lit rows are excluded from the storm signal
+                    ncand = max(ncand - canary_obs["n_above_near"], 0)
+                _health_update(istart,
+                               wall_s=_time.perf_counter() - t_chunk,
+                               candidates=ncand)
+    finally:
+        if obs_server is not None:
+            obs_server.close()
     return results, hits
 
 

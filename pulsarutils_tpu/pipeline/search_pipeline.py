@@ -37,6 +37,9 @@ from ..io.sigproc import FilterbankReader
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import roofline
+from ..obs.canary import CanaryController
+from ..obs.health import HealthEngine
+from ..obs.server import start_obs_server
 from ..obs.trace import begin_span, span as trace_span
 from ..ops.clean_ops import (fft_zap_time, renormalize_data, zero_dm_filter)
 from ..ops.rebin import quick_resample
@@ -46,6 +49,7 @@ from ..pipeline.pulse_info import PulseInfo
 from ..pipeline.spectral_stats import get_bad_chans
 from ..utils.logging_utils import (BudgetAccountant, logger,
                                    measure_device_rtt)
+from ..utils.table import ResultTable
 
 
 def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
@@ -198,7 +202,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      mesh=None, exact_floor="auto", overlap_persist=True,
                      budget=None, dispatch_timeout=None, dispatch_retries=1,
                      dispatch_backoff=0.0, quarantine_policy="sanitize",
-                     persist_retries=2, persist_backoff=0.05):
+                     persist_retries=2, persist_backoff=0.05,
+                     http_port=None, http_host="127.0.0.1", canary=None,
+                     health=None, report_out=None):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -318,6 +324,42 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
       (:func:`~pulsarutils_tpu.faults.audit.audit_run`) cross-checks
       ledger vs candidate files vs manifest and logs any inconsistency.
 
+    Live observability knobs (ISSUE 5; ``docs/observability.md``) —
+    all default-off, and when off the data path is byte-identical to
+    the pre-PR driver:
+
+    * ``http_port`` starts the live HTTP surface
+      (:mod:`~pulsarutils_tpu.obs.server`): ``/metrics`` (live
+      Prometheus scrape), ``/healthz`` (engine verdict, HTTP 503 on
+      CRITICAL), ``/progress`` (chunks done/total of *this session's*
+      work list, ETA, canary recall).  ``0`` binds an ephemeral port;
+      ``http_host`` picks the bind address — the loopback default
+      keeps the surface on-machine, ``"0.0.0.0"`` exposes it to a
+      remote Prometheus scrape job or fleet ``/healthz`` probe;
+    * ``canary`` arms continuous synthetic-pulse injection-recovery
+      (:class:`~pulsarutils_tpu.obs.canary.CanaryController`, or a bare
+      float taken as the injection rate): known-(DM, width, S/N)
+      dispersed pulses on the reader thread, matched against the
+      emitted tables into live recall / S/N-recovery / DM-error
+      metrics.  Canary-matched best rows are tagged and **excluded**
+      from the hits list, candidate files and ledger — when the canary
+      outranks a genuine weaker pulse in the same chunk, that pulse is
+      promoted (persisted with the canary rows masked out of its
+      table) so the science candidate set matches the canary-off run;
+      unsupported (and auto-disabled, with a warning) on the packed
+      low-bit fast path;
+    * ``health`` accepts a caller-owned
+      :class:`~pulsarutils_tpu.obs.health.HealthEngine` (the chaos
+      drill passes one); with ``http_port`` set and no engine given,
+      one is created internally.  The engine receives one update per
+      chunk (wall, candidate count, quarantines, retries, retraces,
+      headroom, canary recall) and folds them into the OK / DEGRADED /
+      CRITICAL verdict ``/healthz`` serves;
+    * ``report_out`` writes the end-of-run survey report (markdown +
+      single-file HTML, :mod:`~pulsarutils_tpu.obs.report`) stitching
+      budget, roofline, canary recall curve, health incidents, sift
+      counters and the quarantine manifest into one artifact.
+
     Returns ``(hits, store)`` where hits is a list of
     ``(istart, iend, PulseInfo, ResultTable)``.  NOTE (round 6): when
     plotting is off, a hit's retained/persisted ``info.allprofs`` is the
@@ -352,6 +394,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     dispatch_policy = DispatchPolicy(timeout_s=dispatch_timeout,
                                      retries=dispatch_retries,
                                      backoff_s=dispatch_backoff)
+    # canary normalisation fails fast too: a bare number is the rate
+    if canary is not None and not isinstance(canary, CanaryController):
+        canary = CanaryController(rate=float(canary))
+    if canary is not None and canary.rate <= 0.0:
+        canary = None  # rate 0 is the documented spelled-out "off"
     logger.info("opening %s", fname)
     # strip only the final extension: "obs.day1.fil" and "obs.day2.fil"
     # must keep distinct candidate roots in a shared output directory
@@ -522,6 +569,21 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     packed_bits = (reader._nbits
                    if (backend == "jax" and reader.nifs == 1
                        and reader._nbits in (1, 2, 4)) else 0)
+    if canary is not None:
+        if packed_bits:
+            # the packed fast path uploads RAW bytes and unpacks on
+            # device: a host-side float injection has no seam there
+            logger.warning(
+                "canary injection is not supported on the packed "
+                "low-bit fast path (raw bytes upload, device unpack): "
+                "canaries DISABLED for this run — recall will not be "
+                "measured")
+            canary = None
+        else:
+            canary.bind(nchan=header["nchans"], start_freq=start_freq,
+                        bandwidth=bandwidth, tsamp=sample_time,
+                        dmmin=dmmin, dmmax=dmmax,
+                        resample=plan.resample)
     device_clean = None
     if backend == "jax":
         import functools
@@ -566,6 +628,64 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             if not (resume and store.is_done(s))]
     if max_chunks is not None:
         todo = todo[:max_chunks]
+
+    # -- live surface (ISSUE 5): health engine + HTTP endpoints ---------
+    if http_port is not None and health is None:
+        health = HealthEngine()
+    t_run0 = time.time()
+
+    def _progress_snapshot():
+        """The ``/progress`` document (read from the scrape thread —
+        plain reads of ints/lists under the GIL)."""
+        done = nproc
+        total = len(todo)
+        elapsed = time.time() - t_run0
+        rate = done / elapsed if elapsed > 0 and done else None
+        doc = {"fname": os.path.basename(str(fname)),
+               "chunks_done": done, "chunks_total": total,
+               "elapsed_s": round(elapsed, 1),
+               "eta_s": (round((total - done) / rate, 1)
+                         if rate else None),
+               "hits": len(hits), "certified": ncertified,
+               "quarantined": len(store.quarantined_chunks)}
+        if canary is not None:
+            doc["canary"] = canary.summary()
+        return doc
+
+    obs_server = None
+    if http_port is not None:
+        obs_server = start_obs_server(http_port, health=health,
+                                      progress_fn=_progress_snapshot,
+                                      host=http_host)
+
+    # health consumes per-chunk DELTAS of process-wide counters (other
+    # runs in this process may have bumped them already)
+    health_base = {}
+    if health is not None:
+        for key, name in (("dead", "putpu_persist_dead_letter_total"),
+                          ("retry", "putpu_dispatch_retries_total"),
+                          ("retrace", "putpu_retraces_total")):
+            health_base[key] = obs_metrics.counter(name).value
+
+    def _health_update(istart, wall_s, candidates=None, quarantined=False,
+                       headroom_frac=None):
+        if health is None:
+            return
+        deltas = {}
+        for key, name in (("dead", "putpu_persist_dead_letter_total"),
+                          ("retry", "putpu_dispatch_retries_total"),
+                          ("retrace", "putpu_retraces_total")):
+            v = obs_metrics.counter(name).value
+            deltas[key] = v - health_base[key]
+            health_base[key] = v
+        health.update(
+            istart, wall_s=wall_s, candidates=candidates,
+            quarantined=quarantined, dead_letter=deltas["dead"] > 0,
+            dispatch_retries=deltas["retry"],
+            retraces=deltas["retrace"], headroom_frac=headroom_frac,
+            fallback=bool(backend != "numpy"
+                          and fallback_state.get("backend") == "numpy"),
+            canary=canary.summary() if canary is not None else None)
 
     from concurrent.futures import ThreadPoolExecutor
 
@@ -614,6 +734,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     time.sleep(0.1 * (2 ** attempt))
             if not packed_bits:
                 block = fault_inject.corrupt("corrupt", block, chunk=s)
+                if canary is not None:
+                    # canary rides AFTER any armed fault corruption: it
+                    # is injected into exactly the bytes the search
+                    # will see, so an RFI storm that masks real pulses
+                    # masks canaries too — which is the point
+                    block = canary.maybe_inject(block, s)
                 # the gate only makes sense for full-rate samples:
                 # quantized low-bit data (1/2/4-bit — packed fast path
                 # OR host-decoded) cannot hold NaN/Inf, and its
@@ -742,6 +868,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     try:
         for ichunk, istart in enumerate(todo):
           with timer.chunk(istart):
+            t_chunk = time.perf_counter()
             chunk_size = min(plan.step, nsamples - istart)
             iend = istart + chunk_size
             t0 = istart * sample_time
@@ -794,6 +921,13 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                           reason=quarantine_reason)
                 array_dev = None  # drop any prefetched device copy
                 nproc += 1
+                if canary is not None:
+                    # the chunk never reaches the search: its pending
+                    # injection must not count as a recall miss
+                    canary.discard(istart)
+                _health_update(istart,
+                               wall_s=time.perf_counter() - t_chunk,
+                               quarantined=True)
                 continue
 
             src = None
@@ -872,8 +1006,78 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     policy=dispatch_policy)
             table, plane = result if capture else (result, None)
 
+            canary_obs = (canary.observe(istart, table, snr_threshold)
+                          if canary is not None else None)
+            ncand_above = None
+            if health is not None:
+                # candidate RATE (table rows above threshold), not the
+                # 0/1 hit decision: the engine's RFI-storm detector
+                # needs the many-DM-trials-at-once signature
+                ncand_above = int(np.count_nonzero(
+                    np.asarray(table["snr"], dtype=np.float64)
+                    > float(snr_threshold)))
+                if canary_obs is not None:
+                    # rows the injection lit must not feed the storm
+                    # detector: an injected chunk's canary sidelobes
+                    # would inflate the candidate-rate baseline
+                    ncand_above = max(
+                        ncand_above - canary_obs["n_above_near"], 0)
+
             best = table.best_row()
             is_hit = bool(best["snr"] > snr_threshold)
+            # sci_table is what downstream consumers see (persist, sift,
+            # cutout window, plots); best_plane_idx indexes the DM-trial
+            # plane for the dedispersed profile.  Both shift only when a
+            # canary tops the chunk and a genuine weaker pulse is
+            # promoted in its place.
+            sci_table = table
+            best_plane_idx = None
+            if is_hit and canary_obs is not None \
+                    and canary_obs["best_is_canary"]:
+                # the chunk's best row IS this chunk's injected canary
+                # (DM *and* dedispersed-time matched): tag it — canaries
+                # must never become candidates, ledger payloads, or sift
+                # input.  A genuine weaker pulse in the same chunk must
+                # persist exactly as the canary-off run would: promote
+                # the strongest row OUTSIDE the canary track, with the
+                # track's rows masked out of the persisted table so
+                # sift/cutout/plots see the real detection as best
+                canary.tag_hit(istart)
+                sci_idx = canary_obs["science_idx"]
+                sci_snr = canary_obs["science_snr"]
+                if sci_idx is not None and sci_snr > float(snr_threshold):
+                    keep = ~canary_obs["canary_rows"]
+                    sci_table = ResultTable(
+                        {name: table[name][keep]
+                         for name in table.colnames}, meta=table.meta)
+                    best = {name: table[name][sci_idx]
+                            for name in table.colnames}
+                    best_plane_idx = int(sci_idx)
+                    obs_metrics.counter(
+                        "putpu_canary_promoted_hits_total").inc()
+                    logger.info(
+                        "chunk %d-%d: canary outranked a genuine pulse "
+                        "— promoted the science best row (DM=%.2f "
+                        "snr=%.2f), canary rows dropped from the "
+                        "persisted table", istart, iend,
+                        float(best["DM"]), float(best["snr"]))
+                else:
+                    is_hit = False
+            elif is_hit and canary_obs is not None \
+                    and canary_obs["recovered"]:
+                # a REAL pulse outranked this chunk's canary: the hit
+                # is genuine and persists, but the per-trial table
+                # saved with it still contains the canary-lit rows —
+                # counted and logged so consumers of the full table
+                # know synthetic rows ride along (the candidate's own
+                # best row is real; see docs/observability.md)
+                obs_metrics.counter(
+                    "putpu_canary_contaminated_tables_total").inc()
+                logger.info(
+                    "chunk %d-%d: real hit persisted alongside a "
+                    "recovered canary — trial rows near DM %.1f in "
+                    "the persisted table include synthetic signal",
+                    istart, iend, canary.dm)
             if getattr(table, "meta", {}).get("certified"):
                 # hybrid noise certificate: the chunk holds no detection
                 # above snr_threshold (up to the certificate's stated
@@ -895,7 +1099,20 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 ncertified += 1
                 obs_metrics.counter("putpu_certified_chunks_total").inc()
 
-            if period_search and plane is not None:
+            if period_search and plane is not None \
+                    and canary_obs is not None:
+                # the folded plane carries the injected canary's track:
+                # a synthetic single pulse must neither resurrect a
+                # tagged canary as a periodicity "hit" (is_hit was set
+                # False above; best still points at the canary row) nor
+                # decorate a real one with its DM — injected chunks
+                # skip the period stage (the injection rate bounds the
+                # loss; canary-off runs are untouched)
+                obs_metrics.counter(
+                    "putpu_canary_period_skips_total").inc()
+                logger.debug("chunk %d-%d: period search skipped on a "
+                             "canary-injected chunk", istart, iend)
+            elif period_search and plane is not None:
                 from ..ops.periodicity import period_search_plane
 
                 # key off the EFFECTIVE backend: a device failure flips
@@ -940,7 +1157,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     info.disp_profile = np.asarray(array.mean(0))
                     if plane is not None:
                         info.dedisp_profile = np.asarray(
-                            plane[table.argbest()])
+                            plane[best_plane_idx
+                                  if best_plane_idx is not None
+                                  else table.argbest()])
                     n_rb += not isinstance(info.allprofs, np.ndarray)
                     if make_plots:
                         # the diagnostic figure needs the full waterfall:
@@ -955,14 +1174,14 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         # round-5 rehearsal's single largest unattributed
                         # wall cost; the persisted record was this
                         # trimmed cutout all along
-                        info = store.trim_waterfall(info, table)
+                        info = store.trim_waterfall(info, sci_table)
                         info.allprofs = np.asarray(info.allprofs)
                     if n_rb:
                         timer.count("readbacks", int(n_rb))
                     obs_metrics.counter("putpu_bytes_readback_total").inc(
                         int(np.asarray(info.allprofs).nbytes))
                 info.compute_stats()
-                hits.append((istart, iend, info, table))
+                hits.append((istart, iend, info, sci_table))
                 obs_metrics.counter("putpu_hits_total").inc()
                 logger.info("HIT chunk %d-%d: DM=%.2f snr=%.2f width=%gs",
                             istart, iend, info.dm, info.snr, info.width)
@@ -970,6 +1189,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             if make_plots == "all" or (make_plots == "hits" and is_hit):
                 from .diagnostics import plot_diagnostics
 
+                # the figure gets the FULL table: its plane panel is
+                # labeled by the table's DM trials row-for-row, so the
+                # canary-masked sci_table cannot back it (a promoted
+                # chunk's figure therefore renders the canary track —
+                # diagnostics, not a candidate artifact)
                 with with_timer("plot"):
                     plot_diagnostics(
                         info, table, plane,
@@ -984,7 +1208,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # the chunk's diagnostic figure: a crash mid-plot leaves the
             # chunk un-marked and the resumed run re-renders it, exactly
             # like the serial loop (code-review r6)
-            payload = (info, table) if is_hit else None
+            payload = (info, sci_table) if is_hit else None
             if persist_pool is not None:
                 pspan = begin_span("persist", track="persist-worker",
                                    chunk=istart)
@@ -1007,11 +1231,20 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # pre-search one found the read still in flight
             if array_dev is None:
                 array_dev = prefetch_upload(next_read)
+            mem_snap = None
             if fallback_state.get("backend", backend) == "jax":
                 # per-chunk device-memory watermark: HBM headroom is a
                 # tracked gauge, not an OOM surprise (obs.memory)
-                obs_memory.record_watermark()
+                mem_snap = obs_memory.record_watermark()
             nproc += 1
+            headroom_frac = None
+            if mem_snap and mem_snap.get("bytes_limit"):
+                headroom_frac = ((mem_snap["bytes_limit"]
+                                  - mem_snap["bytes_in_use"])
+                                 / mem_snap["bytes_limit"])
+            _health_update(istart, wall_s=time.perf_counter() - t_chunk,
+                           candidates=ncand_above,
+                           headroom_frac=headroom_frac)
             if progress and nproc % 50 == 0:
                 logger.info("processed %d chunks (through sample %d/%d)",
                             nproc, iend, nsamples)
@@ -1020,6 +1253,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         reader_pool.shutdown(wait=False, cancel_futures=True)
         if persist_pool is not None:
             persist_pool.shutdown(wait=False, cancel_futures=True)
+        if obs_server is not None:
+            obs_server.close()
         raise
     reader_pool.shutdown(wait=True)
     if persist_pool is not None:
@@ -1028,9 +1263,21 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         with timer.bucket("persist_drain"):
             persist_pool.shutdown(wait=True)
             _drain_persist(block=True)
+    if health is not None and nproc:
+        # tail flush: a persist dead-letter from the final drain (the
+        # last chunk's write overlaps nothing) would otherwise never
+        # reach the engine — one post-drain update folds it in
+        _health_update("drain", wall_s=None)
     timer.report()
     timer.footer()
     logger.info("BUDGET_JSON %s", json.dumps(timer.to_json()))
+    if canary is not None:
+        # one-line machine-readable canary ledger, BUDGET_JSON-style
+        logger.info("CANARY_JSON %s", json.dumps(canary.to_json()))
+    if health is not None:
+        logger.info("health verdict at end of run: %s%s", health.verdict,
+                    " (" + ", ".join(health.reasons()) + ")"
+                    if health.reasons() else "")
     logger.info("done: %d chunks processed, %d hits, %d noise-certified",
                 nproc, len(hits), ncertified)
     if resume:
@@ -1086,4 +1333,30 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                len(report["issues"]), report["issues"])
             else:
                 logger.info("integrity audit: ok %s", report["checked"])
+    if report_out:
+        from ..obs import report as obs_report
+
+        try:  # never fatal — observability must not take down a run
+            md_path, html_path = obs_report.write_report(
+                str(report_out),
+                meta={"root": root,
+                      "fname": os.path.abspath(str(fname)),
+                      "fingerprint": fingerprint,
+                      "chunks_processed": nproc, "hits": len(hits),
+                      "certified": ncertified, "backend": backend,
+                      "kernel": kernel,
+                      "snr_threshold": snr_threshold},
+                budget=timer.to_json(max_per_chunk=0),
+                roofline=roofline.table(),
+                health=health.snapshot() if health is not None else None,
+                canary=canary.to_json() if canary is not None else None,
+                quarantine=manifest.records(),
+                metrics=obs_metrics.REGISTRY.snapshot())
+        except Exception as exc:
+            logger.warning("survey report failed (%r); run result is "
+                           "unaffected", exc)
+        else:
+            logger.info("survey report -> %s + %s", md_path, html_path)
+    if obs_server is not None:
+        obs_server.close()
     return hits, store

@@ -138,7 +138,7 @@ def sift_candidates(cands, time_radius, dm_radius=None, stats=None):
     return kept
 
 
-def sift_hits(hits, time_radius=None, dm_radius=None):
+def sift_hits(hits, time_radius=None, dm_radius=None, stats=None):
     """Sift the ``hits`` list returned by
     :func:`~pulsarutils_tpu.pipeline.search_pipeline.search_by_chunks`
     (``(istart, iend, PulseInfo, ResultTable)`` tuples).
@@ -176,7 +176,12 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
     ``putpu_sift_snr`` / ``putpu_sift_dm`` histograms, and one
     ``SIFT_JSON {...}`` footer line is logged for artifact parsers —
     the sift counterpart of the stream's ``BUDGET_JSON`` footer.
+
+    ``stats`` (optional) is an out-param: pass a dict and the same
+    in/kept/rejected record that feeds SIFT_JSON is written into it —
+    the CLI uses this to fold sift telemetry into the survey report.
     """
+    stats = {} if stats is None else stats
     if not hits:
         return []
     cands = [hit_fields(*h) for h in hits]
@@ -185,7 +190,6 @@ def sift_hits(hits, time_radius=None, dm_radius=None):
             time_radius = 1.5 * max(c["span"] for c in cands)
         else:
             time_radius = "pair-width"
-    stats = {}
     kept = sift_candidates(cands, time_radius, dm_radius, stats=stats)
     _metrics.counter("putpu_sift_candidates_in_total").inc(stats["in"])
     _metrics.counter("putpu_sift_candidates_kept_total").inc(stats["kept"])

@@ -304,6 +304,8 @@ class BudgetAccountant(StageTimer):
     # -- reporting -----------------------------------------------------------
 
     def to_json(self, max_per_chunk=32):
+        from ..obs.gate import SCHEMA_VERSION
+
         nchunks = len(self.chunks)
         wall = sum(c["wall_s"] for c in self.chunks)
         buckets = {}
@@ -313,6 +315,10 @@ class BudgetAccountant(StageTimer):
         top = sum(v for k, v in buckets.items() if "/" not in k)
         unattributed = wall - top
         out = {
+            # versioned footer (ISSUE 5 satellite): parsers and the perf
+            # gate key off this instead of silently comparing records
+            # whose meaning drifted
+            "schema_version": SCHEMA_VERSION,
             "chunks": nchunks,
             "wall_s": round(wall, 3),
             "buckets_s": {k: round(v, 3) for k, v in sorted(

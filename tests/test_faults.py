@@ -245,13 +245,15 @@ def test_default_run_is_inert_and_byte_identical(survey_file, tmp_path):
     assert not [f for f in os.listdir(str(tmp_path / "default"))
                 if f.startswith("quarantine")]
     assert b"quarantined" not in led_a
-    # BUDGET_JSON: same record keys as the round-6/7 ledger, and no
-    # robustness-named buckets leaked into the default path
+    # BUDGET_JSON: same record keys as the round-6/7 ledger (plus the
+    # ISSUE-5 schema_version stamp), and no robustness-named buckets
+    # leaked into the default path
     j = acct.to_json()
-    assert set(j) <= {"chunks", "wall_s", "buckets_s", "unattributed_s",
-                      "attributed_pct", "counters", "async_s", "per_chunk",
-                      "per_chunk_truncated", "truncated_chunks", "rtt_s",
-                      "trips", "trips_x_rtt_s"}
+    assert set(j) <= {"schema_version", "chunks", "wall_s", "buckets_s",
+                      "unattributed_s", "attributed_pct", "counters",
+                      "async_s", "per_chunk", "per_chunk_truncated",
+                      "truncated_chunks", "rtt_s", "trips",
+                      "trips_x_rtt_s"}
     assert not any(("integrity" in k) or ("sanit" in k) or ("retry" in k)
                    for k in j["buckets_s"])
 
@@ -815,3 +817,33 @@ def test_corrupt_saturate_composes_after_nan():
     assert np.isnan(out).any()
     finite = out[np.isfinite(out)]
     assert (finite == finite.max()).mean() > 0.05  # railed
+
+
+def test_corrupt_impulse_rfi_storm_kind():
+    """kind="impulse" (ISSUE 5): bright broadband un-dispersed columns
+    — the candidate-rate-spike signature the health engine's RFI-storm
+    detector consumes.  Deterministic, copy-on-write, amp in block
+    stds, and the non-default amp survives the JSON round trip."""
+    rng = np.random.default_rng(9)
+    block = np.abs(rng.normal(0, 0.5, (16, 1024))) + 20.0
+    plan = FaultPlan([FaultSpec(site="corrupt", kind="impulse",
+                                frac=0.01, amp=50.0, times=None)])
+    with plan.armed():
+        out = fault_inject.corrupt("corrupt", block, chunk=0)
+        again = fault_inject.corrupt("corrupt", block, chunk=0)
+    assert out is not block and (block == np.asarray(block)).all()
+    np.testing.assert_array_equal(out, again)  # seeded per (seed, chunk)
+    delta = out - block
+    hit_cols = np.flatnonzero(np.abs(delta).max(axis=0) > 0)
+    assert len(hit_cols) == 10  # frac * nsamp
+    # broadband: EVERY channel is lifted at the hit columns, by ~amp
+    # times the block std (~0.3 for abs-normal*0.5 noise)
+    assert (delta[:, hit_cols] > 0).all()
+    assert 5.0 < delta[:, hit_cols].mean() < 25.0
+    # amp is serialised only when non-default (existing plan JSON pins)
+    spec_json = plan.specs[0].to_json()
+    assert spec_json["amp"] == 50.0
+    assert "amp" not in FaultSpec(site="corrupt",
+                                  kind="impulse").to_json()
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.specs[0].amp == 50.0

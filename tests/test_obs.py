@@ -185,15 +185,46 @@ def test_metrics_threaded_updates_are_exact():
     assert h._sample()["count"] == 8000
 
 
+def test_prometheus_conformance_golden():
+    # ISSUE 5 satellite: the whole exposition pinned as golden text —
+    # cumulative _bucket samples ending in le="+Inf" == _count,
+    # _sum/_count emission, and label-value escaping of backslash,
+    # double-quote and newline (backslash escaped FIRST)
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("putpu_esc_total", help="has \\ and\nnewline",
+                    reason='du"p\nli\\c')
+    c.inc(2)
+    reg.gauge("putpu_g").set(1.5)
+    h = reg.histogram("putpu_h", help="hist", edges=(0.5, 1.0), kernel="k")
+    h.observe(0.25)
+    h.observe(2.0)
+    assert reg.prometheus_text() == (
+        '# HELP putpu_esc_total has \\\\ and\\nnewline\n'
+        '# TYPE putpu_esc_total counter\n'
+        'putpu_esc_total{reason="du\\"p\\nli\\\\c"} 2\n'
+        '# TYPE putpu_g gauge\n'
+        'putpu_g 1.5\n'
+        '# HELP putpu_h hist\n'
+        '# TYPE putpu_h histogram\n'
+        'putpu_h_bucket{kernel="k",le="0.5"} 1\n'
+        'putpu_h_bucket{kernel="k",le="1.0"} 1\n'
+        'putpu_h_bucket{kernel="k",le="+Inf"} 2\n'
+        'putpu_h_sum{kernel="k"} 2.25\n'
+        'putpu_h_count{kernel="k"} 2\n')
+
+
 # ---------------------------------------------------------------------------
 # BUDGET_JSON byte-compatibility (the span refactor changed the clockwork
 # underneath the accountant; the ledger bytes must not move)
 # ---------------------------------------------------------------------------
 
 #: json.dumps(acct.to_json()) captured on the PRE-refactor accountant
-#: with the same fake clock and operation sequence as the test below
+#: with the same fake clock and operation sequence as the test below.
+#: ISSUE 5 added the leading "schema_version" key (a DELIBERATE byte
+#: change, versioned as such) — every other byte is still pinned.
 _GOLDEN_BUDGET_JSON = (
-    '{"chunks": 2, "wall_s": 1.125, "buckets_s": {"search": 0.625, '
+    '{"schema_version": 1, '
+    '"chunks": 2, "wall_s": 1.125, "buckets_s": {"search": 0.625, '
     '"read": 0.125, "search/dispatch": 0.125, "search/readback": 0.125}, '
     '"unattributed_s": 0.375, "attributed_pct": 66.7, '
     '"counters": {"dispatches": 2, "readbacks": 4}, '
@@ -565,6 +596,65 @@ def test_gate_snapshot_loader(tmp_path):
     assert list(snap) == [1] and snap[1]["value"] == 5.0
 
 
+def test_gate_rejects_missing_or_mismatched_schema_version(tmp_path):
+    # ISSUE 5 satellite: the gate refuses to compare snapshots whose
+    # schema_version header is absent or wrong — never silently
+    versioned = str(tmp_path / "v.jsonl")
+    with open(versioned, "w") as f:
+        f.write(json.dumps({"schema_version": gate.SCHEMA_VERSION}) + "\n")
+        f.write(json.dumps(_rec(1, 5.0, "DM-trials/sec")) + "\n")
+    snap = gate.load_snapshot(versioned,
+                              expect_version=gate.SCHEMA_VERSION)
+    assert snap[1]["value"] == 5.0
+
+    unversioned = str(tmp_path / "u.jsonl")
+    with open(unversioned, "w") as f:
+        f.write(json.dumps(_rec(1, 5.0, "DM-trials/sec")) + "\n")
+    # lenient load still works (ad-hoc tooling over old artifacts)...
+    assert gate.load_snapshot(unversioned)[1]["value"] == 5.0
+    # ...but the enforcing load refuses
+    with pytest.raises(ValueError, match="schema_version"):
+        gate.load_snapshot(unversioned,
+                           expect_version=gate.SCHEMA_VERSION)
+
+    drifted = str(tmp_path / "d.jsonl")
+    with open(drifted, "w") as f:
+        f.write(json.dumps({"schema_version": gate.SCHEMA_VERSION + 1})
+                + "\n")
+        f.write(json.dumps(_rec(1, 5.0, "DM-trials/sec")) + "\n")
+    with pytest.raises(ValueError, match="schema_version"):
+        gate.load_snapshot(drifted, expect_version=gate.SCHEMA_VERSION)
+
+
+def test_gate_cli_rejects_unversioned_snapshot(tmp_path):
+    # end-to-end: the CLI exits 2 (usage/baseline problem) on a fresh
+    # snapshot without the schema_version header
+    baseline = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
+    records = gate.load_snapshot(baseline)
+    unversioned = str(tmp_path / "old.jsonl")
+    with open(unversioned, "w") as f:
+        for rec in records.values():
+            f.write(json.dumps(rec) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--snapshot", unversioned], env=env, cwd=REPO,
+        capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "schema_version" in proc.stderr
+
+
+def test_budget_json_carries_schema_version():
+    from pulsarutils_tpu.utils.logging_utils import BudgetAccountant
+
+    acct = BudgetAccountant()
+    with acct.chunk(0):
+        pass
+    j = acct.to_json()
+    assert list(j)[0] == "schema_version"
+    assert j["schema_version"] == gate.SCHEMA_VERSION
+
+
 def test_gate_cli_doctored_snapshot_fails(tmp_path):
     # the acceptance demonstration, via the actual CLI: a doctored
     # regressed snapshot must exit nonzero against the committed baseline
@@ -573,6 +663,7 @@ def test_gate_cli_doctored_snapshot_fails(tmp_path):
     records = gate.load_snapshot(baseline)
     doctored = str(tmp_path / "doctored.jsonl")
     with open(doctored, "w") as f:
+        f.write(json.dumps({"schema_version": gate.SCHEMA_VERSION}) + "\n")
         for cfg, rec in records.items():
             bad = dict(rec)
             factor = 10.0 if gate.lower_is_better(rec.get("unit")) else 0.1

@@ -15,7 +15,13 @@ asserts the contracts ``docs/robustness.md`` documents:
   persist dead-letter) completes the run with the affected chunks
   recorded in the quarantine manifest + marked done-with-reason in the
   ledger, the *unaffected* chunks' outputs still byte-identical, and
-  the integrity audit reporting zero inconsistencies.
+  the integrity audit reporting zero inconsistencies;
+* the **health engine** (ISSUE 5) sees every run: the fault-free
+  baseline and every recoverable class must end OK, every
+  unrecoverable class must reach DEGRADED/CRITICAL while the fault is
+  live and — when clean chunks follow the last affected one — recover
+  back to OK.  Each class's verdict transitions land in the drill
+  record (``classes.<name>.health.transitions``).
 
 Wired as ``bench_suite.py`` config 9 so the drill result lands next to
 the perf-gate artifacts; the same matrix runs as a ``slow``+``chaos``
@@ -88,6 +94,21 @@ def run_search(path, outdir, plan=None, **kw):
     ctx = plan.armed() if plan is not None else contextlib.nullcontext()
     with ctx:
         return search_by_chunks(path, **params)
+
+
+def _health_record(engine):
+    """Condense a run's HealthEngine into the drill record: every
+    verdict transition, the worst verdict reached, and the final one."""
+    rank = {"OK": 0, "DEGRADED": 1, "CRITICAL": 2}
+    transitions = [
+        {"chunk": t["chunk"], "from": t["from"], "to": t["to"],
+         "reasons": t["reasons"]} for t in engine.transitions]
+    worst = "OK"
+    for t in transitions:
+        if rank[t["to"]] > rank[worst]:
+            worst = t["to"]
+    return {"transitions": transitions, "worst": worst,
+            "final": engine.verdict}
 
 
 def snapshot_outputs(outdir, fingerprint):
@@ -196,6 +217,7 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     """
     from pulsarutils_tpu.faults.audit import audit_run
     from pulsarutils_tpu.faults.inject import FaultPlan
+    from pulsarutils_tpu.obs.health import HealthEngine
     from pulsarutils_tpu.pipeline.spectral_stats import get_bad_chans
 
     t_start = time.time()
@@ -209,7 +231,12 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     get_bad_chans(path)
 
     log("chaos drill: fault-free baseline run")
-    hits, store = run_search(path, os.path.join(base_dir, "baseline"))
+    base_engine = HealthEngine()
+    hits, store = run_search(path, os.path.join(base_dir, "baseline"),
+                             health=base_engine)
+    assert base_engine.verdict == "OK", (
+        f"health engine flagged the fault-free baseline run: "
+        f"{base_engine.snapshot()}")
     fingerprint = store.fingerprint
     assert hits, "baseline run found no candidates — drill is vacuous"
     assert any(lo <= PULSE_T < hi for lo, hi, _, _ in hits)
@@ -220,18 +247,25 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     for name, (recoverable, specs, kw, affected) in _fault_classes().items():
         outdir = os.path.join(base_dir, name)
         plan = FaultPlan(specs)
+        engine = HealthEngine()
         log(f"chaos drill: class {name} "
             f"({'recoverable' if recoverable else 'unrecoverable'})")
         t0 = time.time()
-        hits_f, store_f = run_search(path, outdir, plan=plan, **kw)
+        hits_f, store_f = run_search(path, outdir, plan=plan,
+                                     health=engine, **kw)
         fresh = snapshot_outputs(outdir, fingerprint)
         rec = {"recoverable": recoverable, "fired": plan.fired(),
-               "hits": len(hits_f), "wall_s": round(time.time() - t0, 2)}
+               "hits": len(hits_f), "wall_s": round(time.time() - t0, 2),
+               "health": _health_record(engine)}
         if recoverable:
             diffs = diff_outputs(baseline, fresh)
             rec["byte_identical"] = not diffs
             rec["diffs"] = diffs
-            rec["ok"] = bool(plan.fired()) and not diffs
+            # a transient fault must not leave the run flagged: whatever
+            # flashed during containment, the engine ends the run OK
+            rec["health_ok"] = rec["health"]["final"] == "OK"
+            rec["ok"] = (bool(plan.fired()) and not diffs
+                         and rec["health_ok"])
         else:
             report = audit_run(outdir, fingerprint, root="survey")
             quarantined = {int(k) for k in
@@ -248,8 +282,18 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
                 if not any(f"_{c}-" in n for c in affected)}}
             diffs = diff_outputs(sub_base, sub_fresh, ignore_ledger=True)
             rec["diffs"] = diffs
+            # the health engine must SEE every unrecoverable class
+            # (DEGRADED or CRITICAL at some point), and — when the
+            # fault's last affected chunk precedes the end of the run —
+            # recover back to OK with clean chunks behind it
+            recovery_due = max(affected) < CHUNKS[-1]
+            rec["health_ok"] = (rec["health"]["worst"]
+                                in ("DEGRADED", "CRITICAL")
+                                and (rec["health"]["final"] == "OK"
+                                     or not recovery_due))
             rec["ok"] = (bool(plan.fired()) and report["ok"]
-                         and affected <= quarantined and not diffs)
+                         and affected <= quarantined and not diffs
+                         and rec["health_ok"])
         classes[name] = rec
         log(f"chaos drill: class {name}: "
             f"{'PASS' if rec['ok'] else 'FAIL ' + str(rec)}")
@@ -287,6 +331,8 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
         "n_classes": len(classes),
         "recovered_identical": recovered,
         "contained": contained,
+        "health_ok": all(r.get("health_ok", True)
+                         for r in classes.values()),
         "all_ok": all(r["ok"] for r in classes.values()),
         "classes": classes,
         "wall_s": round(time.time() - t_start, 2),
