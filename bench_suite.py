@@ -620,10 +620,34 @@ def config10(quick):
           "hits": len(hits), "wall_s": round(wall, 2)})
 
 
+def config11(quick):
+    """putpu-lint static invariants as a bench config (ISSUE 6): the
+    AST checkers (device-trip attribution, retrace hazards, lock
+    discipline, metric-name sync, broad excepts, float64 leaks) run
+    over the package — deterministic and sub-second, so it rides every
+    gate run.  ``value`` is 1.0 only when the tree has ZERO new
+    findings; any regression drops it to 0.0, far past any tolerance."""
+    t0 = time.perf_counter()
+    from pulsarutils_tpu.analysis.cli import run_lint
+
+    project = run_lint()
+    rep = project.report()
+    emit({"config": 11,
+          "metric": f"putpu-lint static invariants over {rep['files']} "
+                    f"files ({len(rep['checkers'])} checkers)",
+          "value": 1.0 if rep["clean"] else 0.0,
+          "unit": "lint clean (1 = zero new findings)",
+          "new": rep["new"], "waived": rep["waived"],
+          "baselined": rep["baselined"],
+          "wall_s": round(time.perf_counter() - t0, 3),
+          "findings": sorted(f"{f.location()}: {f.checker}"
+                             for f in project.new_findings())[:20]})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
-                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10])
+                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -640,7 +664,8 @@ def main(argv=None):
     except Exception:
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
+           11: config11}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

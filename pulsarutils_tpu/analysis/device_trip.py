@@ -1,0 +1,289 @@
+"""Undeclared device-trip checker (``device-trip``).
+
+PR 1's contract: **every device round trip is budget-attributed** — a
+readback outside a budget bucket silently lands in the chunk's
+``unattributed`` residual, which is exactly the blind spot the
+BudgetAccountant was built to close (the round-5 rehearsal explained
+only ~6% of wall; the un-attributed full-chunk readback was the rest).
+
+Scope: modules under ``ops/`` and ``parallel/`` (the device-code
+layers).  Flagged spellings — the ways this codebase moves device data
+to host or blocks on it:
+
+* ``np.asarray(x)`` — THE readback idiom (also how JAX forces a
+  dispatch: ``np.asarray(src[:1, :1])``);
+* ``x.item()``, ``x.block_until_ready()``, ``jax.device_get(x)``;
+* ``float(x)`` / ``int(x)`` of a non-obviously-host expression.
+
+Sanctioned seams (not flagged):
+
+* code lexically inside a ``with budget_bucket(...)`` /
+  ``with <acct>.bucket(...)`` / ``with with_timer(...)`` block — the
+  span/budget layer is measuring it, which is the whole point;
+* functions whose *job* is the readback seam, listed in
+  :data:`SANCTIONED_FUNCTIONS` (e.g. ``fetch_global``, the one
+  multi-process-safe fetch; ``measure_device_rtt``, which measures the
+  trip itself);
+* calls whose argument is provably host-side, via a per-function
+  host-value inference: literals, ``np.*``/``math.*`` call results,
+  shape/dtype metadata (``x.shape``, ``.ndim``, ``.size``, ...),
+  results of scalar builtins (``len``/``min``/``max``/``int``/...),
+  local names every assignment of which is host (fixpoint, so
+  ``shifts = np.rint(...); int(shifts.min())`` is clean), and method
+  calls on such names;
+* ``int(x)`` / ``float(x)`` where ``x`` is a bare *parameter* of the
+  enclosing function — scalar coercion at entry is plan-parameter
+  normalisation in this codebase, not a readback (the array-readback
+  spellings ``np.asarray``/``.item()``/``block_until_ready`` get no
+  such grace: a device array argument is exactly what they leak);
+* calls in functions that never touch ``jax``/``jnp`` (pure-host
+  helpers cannot hold device values).
+
+Everything else is either a genuine unattributed trip (fix: wrap it in
+the bucket that should own its wall time) or a host-side conversion the
+checker cannot prove — waive those inline with a one-word reason, or
+grandfather them in the committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import dotted_name, name_root, register
+
+#: functions that ARE the sanctioned readback/measurement seams
+SANCTIONED_FUNCTIONS = {
+    "fetch_global",          # parallel.mesh: multiprocess-safe readback
+    "measure_device_rtt",    # utils.logging_utils: prices the trip
+    "fused_scores_to_host",  # ops.search: the fused kernel's one seam
+}
+
+#: with-context callee names that mark an attributed region
+_BUCKET_CALLS = {"budget_bucket", "bucket", "with_timer", "stage"}
+
+_NUMPY_ROOTS = {"np", "numpy", "math"}
+
+#: builtins whose result is a host scalar/container whatever the input
+#: (a traced value fed to these fails loudly at trace time — the silent
+#: wall-time leak this checker hunts needs a real array)
+_HOST_BUILTINS = {"len", "min", "max", "abs", "round", "sum", "int",
+                  "float", "bool", "range", "sorted", "divmod", "pow"}
+
+#: attributes that are host metadata on any array (device or not)
+_HOST_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "nbytes"}
+
+
+def _is_attributed(ctx, node):
+    """Inside a ``with`` whose context manager is a budget/span bucket?"""
+    for anc in ctx.ancestors(node):
+        if not isinstance(anc, (ast.With, ast.AsyncWith)):
+            continue
+        for item in anc.items:
+            expr = item.context_expr
+            if not isinstance(expr, ast.Call):
+                continue
+            name = dotted_name(expr.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in _BUCKET_CALLS:
+                return True
+    return False
+
+
+def _looks_host(node, host_vars=frozenset()):
+    """Conservatively true for expressions that cannot be device arrays:
+    literals/containers, ``np.*``/``math.*`` call results, host-scalar
+    builtins, shape/dtype metadata, names proven host by
+    :func:`_host_vars` and method calls on any of those."""
+    if isinstance(node, (ast.Constant, ast.List, ast.Tuple, ast.Dict,
+                         ast.Set, ast.ListComp, ast.DictComp,
+                         ast.GeneratorExp, ast.JoinedStr)):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in host_vars
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in _HOST_BUILTINS:
+            return True
+        if name_root(func) in _NUMPY_ROOTS:
+            return True
+        # a method call on a host expression stays host
+        # (``shifts.min()``, ``(shifts - base).astype(np.int32)``)
+        if isinstance(func, ast.Attribute):
+            return _looks_host(func.value, host_vars)
+        return False
+    if isinstance(node, ast.Attribute):
+        if node.attr in _HOST_ATTRS:
+            return True
+        return _looks_host(node.value, host_vars)
+    if isinstance(node, ast.Subscript):
+        return _looks_host(node.value, host_vars)
+    if isinstance(node, ast.BinOp):
+        return (_looks_host(node.left, host_vars)
+                and _looks_host(node.right, host_vars))
+    if isinstance(node, ast.UnaryOp):
+        return _looks_host(node.operand, host_vars)
+    if isinstance(node, ast.IfExp):
+        return (_looks_host(node.body, host_vars)
+                and _looks_host(node.orelse, host_vars))
+    return False
+
+
+def _host_vars(scope):
+    """Names in ``scope`` (a function or module) every assignment of
+    which is a host expression — fixpoint, so host-ness chains through
+    ``a = np.rint(x); b = a.astype(np.int32)``.  Shape-tuple unpacking
+    (``nchan, t = data.shape``) marks each target host.  A name with
+    any non-host assignment (or used as a loop/with/except target) is
+    never host."""
+    assigns = {}      # name -> [value expressions]
+    tainted = set()   # bound by for/with/comprehension/except: unknown
+
+    def bind(target, value):
+        if isinstance(target, ast.Name):
+            assigns.setdefault(target.id, []).append(value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # unpacking a host expression (``nchan, t = data.shape``,
+            # ``a, b = np.shape(x)``) yields host elements; anything
+            # else leaves the targets unknown
+            for el in target.elts:
+                if isinstance(el, ast.Name):
+                    assigns.setdefault(el.id, []).append(value)
+                else:
+                    taint(el)
+
+    def taint(target):
+        if isinstance(target, ast.Name):
+            tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List, ast.Starred)):
+            for el in getattr(target, "elts", [target.value]
+                              if isinstance(target, ast.Starred) else []):
+                taint(el)
+
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = scope.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            tainted.add(arg.arg)  # parameters are unknown, never host
+
+    todo = [scope]
+    while todo:
+        node = todo.pop()
+        if node is not scope and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef, ast.Lambda)):
+            continue  # nested scopes run their own inference
+        todo.extend(ast.iter_child_nodes(node))
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                bind(target, node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            bind(node.target, node.value)
+        elif isinstance(node, ast.AugAssign):
+            bind(node.target, node.value)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            taint(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    taint(item.optional_vars)
+        elif isinstance(node, (ast.NamedExpr,)):
+            taint(node.target)
+
+    hosts = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, values in assigns.items():
+            if name in hosts or name in tainted:
+                continue
+            if all(_looks_host(v, hosts) for v in values):
+                hosts.add(name)
+                changed = True
+    return frozenset(hosts)
+
+
+def _is_param(scope, name):
+    """Is ``name`` a parameter of ``scope`` (a function def)?"""
+    if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    a = scope.args
+    return any(arg.arg == name for arg in
+               a.posonlyargs + a.args + a.kwonlyargs)
+
+
+def _function_touches_jax(fn):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in ("jax", "jnp"):
+            return True
+    return False
+
+
+@register
+class DeviceTripChecker:
+    id = "device-trip"
+    ids = ("device-trip",)
+
+    def check(self, ctx):
+        pkg = ctx.pkgpath or ""
+        if not (pkg.startswith("ops/") or pkg.startswith("parallel/")):
+            return []
+        out = []
+        jax_fns = {}    # FunctionDef -> touches-jax (memoized)
+        host_vars = {}  # scope node -> frozenset of proven-host names
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            scope = ctx.enclosing_function(node) or ctx.tree
+            if scope not in host_vars:
+                host_vars[scope] = _host_vars(scope)
+            label = self._trip_label(node, scope, host_vars[scope])
+            if label is None:
+                continue
+            if _is_attributed(ctx, node):
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is not None and fn.name in SANCTIONED_FUNCTIONS:
+                continue
+            # pure-host helpers cannot hold device values; only apply
+            # this escape to the value-conversion spellings — an
+            # explicit block_until_ready/device_get is device by name
+            if label in ("np.asarray", "float()", "int()", ".item()"):
+                if fn is not None:
+                    if fn not in jax_fns:
+                        jax_fns[fn] = _function_touches_jax(fn)
+                    if not jax_fns[fn]:
+                        continue
+                elif not _function_touches_jax(ctx.tree):
+                    continue
+            out.append(ctx.finding(
+                node, "device-trip",
+                f"{label} outside a budget bucket in {pkg} — a device "
+                "trip here lands in the chunk's unattributed residual; "
+                "wrap it in the bucket that owns its wall time (or "
+                "waive with a reason if provably host-side)"))
+        return out
+
+    def _trip_label(self, call, scope, hosts):
+        func = call.func
+        name = dotted_name(func)
+        if name in ("np.asarray", "numpy.asarray"):
+            if call.args and not _looks_host(call.args[0], hosts):
+                return "np.asarray"
+            return None
+        if name in ("jax.device_get",):
+            return "jax.device_get"
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready":
+                return ".block_until_ready()"
+            if func.attr == "item" and not call.args \
+                    and not _looks_host(func.value, hosts):
+                return ".item()"
+        if isinstance(func, ast.Name) and func.id in ("float", "int") \
+                and len(call.args) == 1 and not call.keywords:
+            arg = call.args[0]
+            if isinstance(arg, ast.Name) and _is_param(scope, arg.id):
+                return None  # scalar coercion of a plan parameter
+            if not _looks_host(arg, hosts):
+                return f"{func.id}()"
+        return None

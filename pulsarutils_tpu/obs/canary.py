@@ -43,6 +43,10 @@ Containment rules (the ledger/candidate byte contract):
 Injection preserves the block's dtype (integer survey data is bumped by
 the rounded amplitude and clipped to the dtype's rails) so the device
 clean/search signature never drifts and injected chunks cannot retrace.
+
+Every ``putpu_canary_*`` metric emitted here is declared (with its
+meaning) in :mod:`.names`; the ``putpu-lint`` metric-name checker keeps
+the two in sync.
 """
 
 from __future__ import annotations
@@ -122,24 +126,29 @@ class CanaryController:
         """
         from ..ops.plan import dedispersion_shifts
 
-        if self._bound:
-            return self
-        if self.dm is None:
-            if dmmin is None or dmmax is None:
-                raise ValueError("canary dm unset and no search DM range "
-                                 "to derive it from")
-            self.dm = round(0.5 * (float(dmmin) + float(dmmax)), 3)
-        self._resample = max(int(resample), 1)
-        if self.width_s is None:
-            self._width = max(2 * int(resample), 2)
-        else:
-            self._width = max(int(round(self.width_s / tsamp)), 1)
-        shifts = dedispersion_shifts(nchan, self.dm, start_freq,
-                                     bandwidth, tsamp)
-        # same rounding + roll-forward convention as models.simulate.
-        # disperse_array — the search's dedisperse undoes exactly this
-        self._shifts = np.rint(np.asarray(shifts)).astype(np.int64)
-        self._bound = True
+        # under the lock end to end: stream_search binds lazily from the
+        # reader thread, so an unlocked check-then-mutate here could let
+        # two binders interleave half-written track state
+        # (putpu-lint lock-discipline caught exactly this)
+        with self._lock:
+            if self._bound:
+                return self
+            if self.dm is None:
+                if dmmin is None or dmmax is None:
+                    raise ValueError("canary dm unset and no search DM "
+                                     "range to derive it from")
+                self.dm = round(0.5 * (float(dmmin) + float(dmmax)), 3)
+            self._resample = max(int(resample), 1)
+            if self.width_s is None:
+                self._width = max(2 * int(resample), 2)
+            else:
+                self._width = max(int(round(self.width_s / tsamp)), 1)
+            shifts = dedispersion_shifts(nchan, self.dm, start_freq,
+                                         bandwidth, tsamp)
+            # same rounding + roll-forward convention as models.simulate.
+            # disperse_array — the search's dedisperse undoes exactly this
+            self._shifts = np.rint(np.asarray(shifts)).astype(np.int64)
+            self._bound = True
         logger.info("canary armed: rate=%.3g DM=%.2f target S/N=%.1f "
                     "width=%d raw samples", self.rate, self.dm, self.snr,
                     self._width)

@@ -25,7 +25,8 @@ from __future__ import annotations
 import json
 
 __all__ = ["DEFAULT_REL_TOL", "SCHEMA_VERSION", "load_snapshot",
-           "lower_is_better", "compare", "format_report"]
+           "lower_is_better", "compare", "format_report",
+           "check_lint_report", "unknown_budget_counters"]
 
 #: snapshot/footer schema version.  Written as the first line of every
 #: ``--metrics-out`` snapshot (``{"schema_version": N}``) and embedded
@@ -146,6 +147,49 @@ def compare(baseline, fresh, rel_tol=DEFAULT_REL_TOL, per_config_tol=None,
                              f"(tolerance {100 * tol:.0f}%)")
             ok = False
     return ok, rows
+
+
+def check_lint_report(path):
+    """``(ok, detail)`` for a ``putpu_lint.py --out`` JSON report.
+
+    The perf gate refuses to PASS on a missing, unreadable or non-clean
+    report: the static invariants (device-trip attribution, retrace
+    hazards, lock discipline, metric-name sync, ...) gate the same way
+    perf does — a convention regression is a regression."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return False, (f"lint report {path} missing — generate it with "
+                       f"`python tools/putpu_lint.py --out {path} "
+                       "pulsarutils_tpu/`")
+    except (OSError, json.JSONDecodeError) as exc:
+        return False, f"lint report {path} unreadable: {exc}"
+    if doc.get("tool") != "putpu-lint":
+        return False, (f"{path} is not a putpu-lint report "
+                       f"(tool={doc.get('tool')!r})")
+    if doc.get("clean"):
+        return True, (f"clean ({doc.get('files')} files, "
+                      f"{doc.get('waived')} waived, "
+                      f"{doc.get('baselined')} baselined)")
+    return False, (f"{doc.get('new')} new lint finding(s) — run "
+                   "`python tools/putpu_lint.py pulsarutils_tpu/` for "
+                   "locations")
+
+
+def unknown_budget_counters(records):
+    """Budget-counter keys in snapshot records that the
+    :mod:`.names` manifest does not declare — a renamed counter whose
+    ``BUDGET_COUNTERS`` row was left behind would otherwise drift out
+    of the doc/baseline coverage guarantee silently."""
+    from .names import BUDGET_COUNTERS
+
+    bad = set()
+    for rec in records.values():
+        for key in (rec.get("counters") or {}):
+            if key not in BUDGET_COUNTERS:
+                bad.add(key)
+    return sorted(bad)
 
 
 def format_report(rows):

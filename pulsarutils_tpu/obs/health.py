@@ -34,6 +34,9 @@ operator) can replay exactly when the run degraded and recovered.
 
 Thread-safe: the HTTP scrape thread (:mod:`.server`) reads
 :meth:`snapshot` while the chunk loop calls :meth:`update`.
+
+``putpu_health_*`` metric names are declared in :mod:`.names`; the
+``putpu-lint`` metric-name checker keeps emissions and manifest in sync.
 """
 
 from __future__ import annotations

@@ -15,12 +15,21 @@ reader and persist worker threads concurrently with the main loop);
 metric update cost is a lock + an add, safe for per-chunk cadence hot
 paths.  Instruments are get-or-create by ``(name, labels)`` so call
 sites never coordinate registration.
+
+``putpu_*`` names are declared in :mod:`.names` — the single-source
+manifest the ``putpu-lint`` metric-name checker enforces statically.
+The registry consumes it at runtime too: an instrument created without
+``help=`` inherits the manifest's one-line meaning as its Prometheus
+HELP text, and the module-level facades warn once per unknown
+``putpu_*`` name instead of silently minting a new series.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+
+from . import names as _names
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram"]
@@ -201,6 +210,10 @@ class MetricsRegistry:
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
+                if not help:
+                    # single-source meaning: the manifest's one-line
+                    # description becomes the Prometheus HELP text
+                    help = _names.METRIC_NAMES.get(name, "")
                 m = cls(name, help=help, labels=key[1], **kw)
                 self._metrics[key] = m
             elif not isinstance(m, cls):
@@ -271,12 +284,15 @@ REGISTRY = MetricsRegistry()
 
 
 def counter(name, help="", **labels):
+    _names.warn_unknown(name)
     return REGISTRY.counter(name, help=help, **labels)
 
 
 def gauge(name, help="", **labels):
+    _names.warn_unknown(name)
     return REGISTRY.gauge(name, help=help, **labels)
 
 
 def histogram(name, help="", edges=DEFAULT_EDGES, **labels):
+    _names.warn_unknown(name)
     return REGISTRY.histogram(name, help=help, edges=edges, **labels)

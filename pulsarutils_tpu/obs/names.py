@@ -1,0 +1,169 @@
+"""Single-source manifest of every ``putpu_*`` metric name.
+
+Five PRs of telemetry growth left ``putpu_*`` names scattered as string
+literals across ``obs/``, the drivers, the fault layer and the sift —
+and the only thing keeping the perf gate's baselines, the docs and the
+emitting call sites in agreement was reviewer memory.  This module is
+the agreement, written down: **every metric name the framework emits is
+declared here**, with its one-line meaning, and the ``metric-name``
+checker of :mod:`pulsarutils_tpu.analysis` statically enforces both
+directions —
+
+* a ``putpu_*`` literal passed to ``counter()``/``gauge()``/
+  ``histogram()`` anywhere in the tree must appear in this manifest;
+* every manifest name must be emitted somewhere (or be a declared
+  dynamic budget counter), and every ``putpu_*`` token in the docs or
+  the committed gate baseline must resolve against it.
+
+The runtime facades cross-check too (:func:`warn_unknown`): an unknown
+name logs one warning instead of silently minting a new series.  Keep
+this module stdlib-only — the static analyzer parses it without
+importing the package.
+"""
+
+from __future__ import annotations
+
+__all__ = ["METRIC_NAMES", "BUDGET_COUNTERS", "budget_counter_metric",
+           "is_known", "warn_unknown"]
+
+#: every statically-named metric: name -> one-line meaning.  Sorted.
+METRIC_NAMES = {
+    "putpu_audit_issues_total":
+        "end-of-run integrity audit inconsistencies",
+    "putpu_bytes_readback_total":
+        "bytes copied device -> host",
+    "putpu_bytes_uploaded_total":
+        "bytes copied host -> device",
+    "putpu_canary_contaminated_tables_total":
+        "real hits persisted with canary-lit trial rows in their table",
+    "putpu_canary_discarded_total":
+        "pending canary injections dropped (chunk never searched)",
+    "putpu_canary_dm_error":
+        "histogram of |DM error| for recovered canaries",
+    "putpu_canary_injected_total":
+        "canary pulses observed by the search",
+    "putpu_canary_missed_total":
+        "canary pulses the search failed to recover",
+    "putpu_canary_period_skips_total":
+        "folded period-search stages skipped on injected chunks",
+    "putpu_canary_promoted_hits_total":
+        "genuine weaker pulses promoted when a canary topped the chunk",
+    "putpu_canary_recall":
+        "cumulative canary recall (recovered / injected)",
+    "putpu_canary_recovered_total":
+        "canary pulses recovered above the hit threshold",
+    "putpu_canary_snr_ratio":
+        "histogram of measured/target canary S/N",
+    "putpu_canary_tagged_hits_total":
+        "chunk best rows tagged as the canary and excluded",
+    "putpu_canary_window_recall":
+        "recall over the rolling canary window",
+    "putpu_certified_chunks_total":
+        "chunks whose hybrid noise certificate held",
+    "putpu_chunks_per_s":
+        "end-of-run survey throughput",
+    "putpu_chunks_quarantined_total":
+        "chunks quarantined by the integrity gate",
+    "putpu_chunks_sanitized_total":
+        "chunks NaN-imputed by the sanitize policy",
+    "putpu_chunks_total":
+        "chunk budgets closed",
+    "putpu_device_bytes_in_use":
+        "device memory currently allocated",
+    "putpu_device_bytes_limit":
+        "device memory limit reported by the allocator",
+    "putpu_device_bytes_peak":
+        "process-lifetime device-memory high-water mark",
+    "putpu_device_headroom_bytes":
+        "device memory limit minus in-use",
+    "putpu_dispatch_retries_total":
+        "chunk searches re-attempted after failure/timeout",
+    "putpu_faults_injected_total":
+        "fault-plan firings (labelled by site)",
+    "putpu_health_incidents_total":
+        "health conditions raised (labelled by kind)",
+    "putpu_health_status":
+        "current verdict as rank (0 OK / 1 DEGRADED / 2 CRITICAL)",
+    "putpu_hits_total":
+        "chunks whose best S/N cleared the threshold",
+    "putpu_persist_dead_letter_total":
+        "candidate persists abandoned to the dead-letter manifest",
+    "putpu_persist_retries_total":
+        "candidate persists re-attempted after OSError",
+    "putpu_quarantine_records_total":
+        "records appended to the quarantine manifest",
+    "putpu_read_retries_total":
+        "chunk reads re-attempted after OSError",
+    "putpu_resume_pairs_skipped_total":
+        "unreadable ledger/candidate pairs skipped at resume",
+    "putpu_retraces_total":
+        "XLA compiles observed after a stream's first chunk",
+    "putpu_roofline_frac_of_ideal":
+        "last-dispatch achieved fraction of the roofline bound",
+    "putpu_roofline_gbytes_per_s":
+        "last-dispatch achieved memory bandwidth",
+    "putpu_roofline_gflops":
+        "last-dispatch achieved GFLOP/s",
+    "putpu_sift_candidates_in_total":
+        "candidates entering the sift",
+    "putpu_sift_candidates_kept_total":
+        "candidates surviving the sift",
+    "putpu_sift_dm":
+        "histogram of kept-candidate DM",
+    "putpu_sift_rejected_total":
+        "sift rejections (labelled by reason)",
+    "putpu_sift_snr":
+        "histogram of kept-candidate S/N",
+    "putpu_stream_chunks_failed_total":
+        "stream chunks dropped under skip_failed containment",
+    "putpu_stream_chunks_total":
+        "chunks completed by stream_search",
+    "putpu_stream_hits_total":
+        "stream chunks whose best S/N cleared the threshold",
+}
+
+#: per-chunk budget counters mirrored dynamically by
+#: ``BudgetAccountant.count(name)`` as ``putpu_<name>_total`` — the one
+#: sanctioned dynamic-name seam (waived at its call site).  Adding a new
+#: ``count()`` name means adding it here, or the runtime warns and the
+#: doc/baseline coverage check cannot vouch for it.
+BUDGET_COUNTERS = frozenset({
+    "dispatches",
+    "host_sweeps",
+    "offset_tables",
+    "prefetch_uploads",
+    "readbacks",
+    "rescore_calls",
+    "rescore_rows",
+})
+
+
+def budget_counter_metric(name):
+    """The registry metric name a budget counter is mirrored under."""
+    return f"putpu_{name}_total"
+
+
+def is_known(name):
+    """True when ``name`` is a declared metric (static or dynamic)."""
+    if name in METRIC_NAMES:
+        return True
+    return (name.startswith("putpu_") and name.endswith("_total")
+            and name[len("putpu_"):-len("_total")] in BUDGET_COUNTERS)
+
+
+_warned = set()
+
+
+def warn_unknown(name):
+    """Log (once per name) when an emitted ``putpu_*`` name is missing
+    from the manifest — the runtime mirror of the static check, for code
+    paths the linter cannot see (plugins, interactive sessions)."""
+    if not name.startswith("putpu_") or is_known(name) or name in _warned:
+        return
+    _warned.add(name)
+    import logging
+
+    logging.getLogger("pulsarutils_tpu").warning(
+        "metric %r is not declared in pulsarutils_tpu.obs.names — add it "
+        "to METRIC_NAMES (the putpu-lint metric-name checker enforces "
+        "this statically)", name)

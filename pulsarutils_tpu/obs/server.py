@@ -129,7 +129,7 @@ class ObsServer:
         try:
             self._httpd.shutdown()
             self._httpd.server_close()
-        except Exception:
+        except OSError:  # socket already torn down
             pass
         self._thread.join(timeout=5.0)
 

@@ -319,7 +319,8 @@ def fold_batch(series, freqs, tsamp, nbin=32, t0=0.0, xp=np):
     must be concrete host values (they parameterise the float64 anchor
     table, not the traced computation).
     """
-    freqs = np.asarray(freqs, dtype=np.float64)
+    freqs = np.asarray(  # putpu-lint: disable=device-trip — concrete host anchors by contract
+        freqs, dtype=np.float64)
     if xp is np:
         folded = [fold(series, f, tsamp, nbin, t0) for f in freqs]
         return (np.stack([p for p, _ in folded]),

@@ -1271,12 +1271,17 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
             # cheap, and row-major on TPU unlike the scalarising lane
             # gather)
             plane = plane[idx]
-        maxvalues = np.asarray(c_max, np.float64)[idx]
-        stds = np.asarray(c_std, np.float64)[idx]
-        snrs = np.asarray(c_snr, np.float64)[idx]
-        windows = np.asarray(c_win, np.int32)[idx]
-        peaks = np.asarray(c_peak, np.int64)[idx]
-        cert_scores = np.asarray(c_cert, np.float64)[idx]
+        # the coarse score vectors come back from the device here — the
+        # fused path's readback is bucketed above, and this two-stage
+        # path must attribute the same trip (putpu-lint device-trip)
+        with budget_bucket("search/coarse_readback"):
+            maxvalues = np.asarray(c_max, np.float64)[idx]
+            stds = np.asarray(c_std, np.float64)[idx]
+            snrs = np.asarray(c_snr, np.float64)[idx]
+            windows = np.asarray(c_win, np.int32)[idx]
+            peaks = np.asarray(c_peak, np.int64)[idx]
+            cert_scores = np.asarray(c_cert, np.float64)[idx]
+            budget_count("readbacks")
 
     coarse_snrs = snrs.copy()
     exact = np.zeros(ndm, dtype=bool)

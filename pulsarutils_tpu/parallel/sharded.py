@@ -129,7 +129,8 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     if trial_dms is None:
         trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
                                       bandwidth, sample_time)
-    trial_dms = np.asarray(trial_dms, dtype=np.float64)
+    trial_dms = np.asarray(  # putpu-lint: disable=device-trip — host DM plan list
+        trial_dms, dtype=np.float64)
     ndm = len(trial_dms)
 
     if offsets is None:
@@ -140,7 +141,8 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
         offsets = _offsets_for(trial_dms, nchan, start_freq, bandwidth,
                                sample_time, nsamples)
     else:
-        offsets = np.asarray(offsets, dtype=np.int32)
+        offsets = np.asarray(  # putpu-lint: disable=device-trip — host offset table
+            offsets, dtype=np.int32)
         if offsets.shape != (ndm, nchan):
             raise ValueError(f"offsets shape {offsets.shape} does not "
                              f"match ({ndm}, {nchan})")
@@ -152,8 +154,12 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     offsets, _ = pad_to_multiple(offsets, 0, dm_size, mode="edge")
     offsets, _ = pad_to_multiple(offsets, 1, chan_size, mode="constant")
     if nchan % chan_size:
-        data_padded, _ = pad_to_multiple(np.asarray(data), 0, chan_size,
-                                         mode="constant")
+        # a device-resident input bounces through the host on this
+        # misaligned-channel path — attribute the trip (putpu-lint
+        # device-trip); the aligned branch below keeps it on-device
+        with budget_bucket("search/plan"):
+            data_padded, _ = pad_to_multiple(np.asarray(data), 0,
+                                             chan_size, mode="constant")
     else:
         # already aligned: keep the caller's array — a device-resident
         # input (e.g. the sharded hybrid's repeated rescore calls) must

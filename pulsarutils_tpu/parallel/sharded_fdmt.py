@@ -671,16 +671,20 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
                                          capture_plane=capture_plane)
         t_coarse, plane = (coarse_out if capture_plane
                            else (coarse_out, None))
-        idx = nearest_rows(np.asarray(t_coarse["DM"]), trial_dms)
-        if plane is not None:
-            plane = plane.remap(idx)  # coarse rows -> plan grid, sharded
-
-        maxvalues = np.asarray(t_coarse["max"], np.float64)[idx]
-        stds = np.asarray(t_coarse["std"], np.float64)[idx]
-        snrs = np.asarray(t_coarse["snr"], np.float64)[idx]
-        windows = np.asarray(t_coarse["rebin"], np.int32)[idx]
-        peaks = np.asarray(t_coarse["peak"], np.int64)[idx]
-        cert_scores = np.asarray(t_coarse["cert"], np.float64)[idx]
+        # coarse-table columns may still be device-backed; attribute the
+        # conversion like every other coarse readback (putpu-lint
+        # device-trip)
+        with budget_bucket("search/coarse_readback"):
+            idx = nearest_rows(np.asarray(t_coarse["DM"]), trial_dms)
+            if plane is not None:
+                plane = plane.remap(idx)  # coarse rows -> plan grid
+            maxvalues = np.asarray(t_coarse["max"], np.float64)[idx]
+            stds = np.asarray(t_coarse["std"], np.float64)[idx]
+            snrs = np.asarray(t_coarse["snr"], np.float64)[idx]
+            windows = np.asarray(t_coarse["rebin"], np.int32)[idx]
+            peaks = np.asarray(t_coarse["peak"], np.int64)[idx]
+            cert_scores = np.asarray(t_coarse["cert"], np.float64)[idx]
+            budget_count("readbacks")
 
     coarse_snrs = snrs.copy()
     exact = np.zeros(ndm, dtype=bool)

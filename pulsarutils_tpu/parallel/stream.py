@@ -29,6 +29,7 @@ import numpy as np
 
 from ..ops.plan import delta_delay, dm_broadening
 from ..ops.search import dedispersion_search
+from ..utils.logging_utils import budget_bucket
 
 
 @dataclasses.dataclass(frozen=True)
@@ -453,14 +454,19 @@ def ring_dedisperse(data, trial_dms, start_freq, bandwidth, sample_time,
     """
     import jax.numpy as jnp
 
-    data = np.asarray(data)
+    # host normalisation of the input: for a device-resident array this
+    # is a full-chunk readback — attribute it instead of letting it land
+    # in the unattributed residual (putpu-lint device-trip)
+    with budget_bucket("search/readback"):
+        data = np.asarray(data)
     nchan, nsamples = data.shape
     n_time = mesh.shape["time"]
     if nsamples % n_time:
         raise ValueError(f"T={nsamples} not divisible by time axis {n_time}")
     t_loc = nsamples // n_time
 
-    trial_dms = np.asarray(trial_dms, dtype=np.float64)
+    trial_dms = np.asarray(  # putpu-lint: disable=device-trip — host DM plan list
+        trial_dms, dtype=np.float64)
     from ..ops.plan import dedispersion_shifts_batch
     shifts = np.rint(dedispersion_shifts_batch(
         trial_dms, nchan, start_freq, bandwidth,

@@ -285,6 +285,9 @@ class BudgetAccountant(StageTimer):
         self.counters_total[name] = self.counters_total.get(name, 0) + n
         # mirror into the process metrics registry (Prometheus/JSONL
         # exporters); the budget ledger stays the per-run source of truth
+        # the ONE sanctioned dynamic-name seam; the possible names are
+        # enumerated as BUDGET_COUNTERS in obs/names.py
+        # putpu-lint: disable=metric-name-dynamic — enumerated manifest seam
         _metrics.counter(f"putpu_{name}_total").inc(n)
 
     def add_async(self, name, dt):

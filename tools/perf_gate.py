@@ -22,8 +22,15 @@ configs it covers — any config that emits a value record works (config
     python tools/perf_gate.py --baseline BENCH_GATE_tpu.jsonl \
         --configs 1 6 7 --preset full
 
+PASS also requires the static-invariant gate: putpu-lint must report
+zero new findings (run in-process by default; point ``--lint-report``
+at a pre-generated ``putpu_lint.py --out`` JSON artifact to check that
+instead — a missing or non-clean report refuses the PASS), and every
+budget-counter name in the snapshots must be declared in
+``pulsarutils_tpu/obs/names.py``.
+
 Exit codes: 0 = within tolerance, 1 = regression/missing/errored
-config, 2 = usage/baseline problems.
+config or lint failure, 2 = usage/baseline problems.
 """
 
 import argparse
@@ -42,9 +49,11 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: with the repo (config 1: the NumPy reference sweep, 7: the
 #: instrumented streaming budget, 10: the canary survey — its gated
 #: value is canary RECALL, so detection-efficiency regressions fail
-#: the same gate as perf ones; all three run in tier-1-scale time)
+#: the same gate as perf ones; 11: the putpu-lint static-invariant
+#: sweep, gated as value 1.0 = clean; all four run in tier-1-scale
+#: time)
 DEFAULT_BASELINE = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
-DEFAULT_CONFIGS = (1, 7, 10)
+DEFAULT_CONFIGS = (1, 7, 10, 11)
 
 #: per-config tolerance defaults (overridable with --tol).  The global
 #: 60% tolerance absorbs CPU wall-clock jitter, but config 10's value
@@ -69,6 +78,21 @@ def run_suite(configs, preset, out_path):
           f"BENCH_PRESET={env.get('BENCH_PRESET', 'full')})",
           file=sys.stderr, flush=True)
     subprocess.run(cmd, env=env, cwd=REPO, check=True)
+
+
+def run_lint_inprocess():
+    """Run putpu-lint over the package in-process; ``(ok, detail)``."""
+    from pulsarutils_tpu.analysis.cli import run_lint
+
+    project = run_lint()
+    rep = project.report()
+    if rep["clean"]:
+        return True, (f"clean ({rep['files']} files, {rep['waived']} "
+                      f"waived, {rep['baselined']} baselined)")
+    locs = [f"{f.location()}: {f.checker}"
+            for f in project.new_findings()]
+    shown = "; ".join(locs[:5]) + (" ..." if len(locs) > 5 else "")
+    return False, f"{rep['new']} new finding(s): {shown}"
 
 
 def parse_tol(items):
@@ -106,6 +130,13 @@ def main(argv=None):
     parser.add_argument("--tol", action="append", metavar="CONFIG=REL",
                         help="per-config tolerance override, repeatable "
                              "(e.g. --tol 7=0.8)")
+    parser.add_argument("--lint-report", metavar="PATH", default=None,
+                        help="pre-generated `putpu_lint.py --out` JSON "
+                             "report to check (default: run the linter "
+                             "in-process — stdlib-only, sub-second)")
+    parser.add_argument("--skip-lint", action="store_true",
+                        help="gate on perf only (NOT for CI: the lint "
+                             "gate is part of PASS)")
     opts = parser.parse_args(argv)
 
     if not os.path.exists(opts.baseline):
@@ -151,10 +182,29 @@ def main(argv=None):
                             per_config_tol=per_config,
                             configs=opts.configs)
     print(gate.format_report(rows))
-    if ok:
+
+    # budget-counter names in the snapshots must resolve against the
+    # obs/names.py manifest (the same source putpu-lint checks emitters
+    # and docs against) — a renamed counter fails here, not in prod
+    drifted = gate.unknown_budget_counters({**baseline, **fresh})
+    if drifted:
+        print(f"perf_gate: snapshot counter name(s) not declared in "
+              f"obs/names.py BUDGET_COUNTERS: {', '.join(drifted)}")
+        ok = False
+
+    # the lint gate: static invariants regress the same way perf does
+    if opts.skip_lint:
+        lint_ok, detail = True, "skipped (--skip-lint)"
+    elif opts.lint_report:
+        lint_ok, detail = gate.check_lint_report(opts.lint_report)
+    else:
+        lint_ok, detail = run_lint_inprocess()
+    print(f"perf_gate: lint {'ok' if lint_ok else 'FAIL'} — {detail}")
+
+    if ok and lint_ok:
         print("perf_gate: PASS")
         return 0
-    print("perf_gate: FAIL (regression or missing config — see rows "
+    print("perf_gate: FAIL (regression, missing config or lint — see "
           "above; committed baselines live at BENCH_GATE_*.jsonl)")
     return 1
 

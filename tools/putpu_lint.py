@@ -1,0 +1,23 @@
+"""putpu-lint: AST-level invariant checker for this repo's conventions.
+
+Thin CLI wrapper over :mod:`pulsarutils_tpu.analysis` (stdlib-only, no
+JAX needed).  The committed-tree invariant the suite pins::
+
+    JAX_PLATFORMS=cpu python tools/putpu_lint.py pulsarutils_tpu/
+
+must exit 0 — every finding is fixed, inline-waived with a reason, or
+grandfathered in ``.putpu-lint-baseline.json``.  ``--help`` for the
+full surface (JSON reports, baseline update, checker selection); the
+same entry installs as the ``putpu-lint`` console script.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pulsarutils_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
