@@ -26,6 +26,13 @@ all). Failures in one config don't stop the others.
      engine's verdict transitions (must flip to DEGRADED on the storm
      and recover)
 
+ 11  putpu-lint static invariants (value 1.0 = zero new findings)
+ 12  tuned-vs-static kernel="auto" A/B (ISSUE 7): the measured
+     autotuner from an empty cache against the PUTPU_AUTOTUNE=off
+     static heuristic — same data, byte-identical tables required,
+     zero steady-state tuning resolutions, and the CPU winner must
+     reproduce PR 1's roll-scan choice by measurement
+
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
 
@@ -644,10 +651,97 @@ def config11(quick):
                              for f in project.new_findings())[:20]})
 
 
+def config12(quick):
+    """Tuned-vs-static ``kernel="auto"`` A/B (ISSUE 7): the measured
+    autotuner against the static heuristic it replaced, on one
+    geometry, same data.  The static arm runs with the tuner's
+    ``off`` mode (the ``PUTPU_AUTOTUNE=off`` escape hatch, byte for
+    byte); the tuned arm starts from an EMPTY cache, pays the
+    measurement on first sight, then runs steady-state.  ``value`` is
+    the static/tuned wall ratio (~1.0 on CPU, where both arms resolve
+    to the PR 1 roll-scan) — forced to 0.0, far past any tolerance,
+    when an invariant breaks: the tuned winner must reproduce the
+    measured CPU roll-scan choice, the steady-state run must perform
+    ZERO tuning resolutions, and the two arms' tables must be
+    byte-identical (tuning may change speed, never hits)."""
+    import tempfile
+
+    import jax
+
+    from pulsarutils_tpu.ops.search import dedispersion_search
+    from pulsarutils_tpu.tuning import autotune
+    from pulsarutils_tpu.tuning.cache import TuneCache
+
+    nchan, nsamp, ndm = ((256, 1 << 16, 128) if not quick
+                         else (64, 1 << 13, 64))
+    array = simulate(nchan, nsamp, seed=12)
+    dms = np.linspace(300., 360., ndm)
+
+    def run():
+        return dedispersion_search(array, None, None, *GEOM,
+                                   backend="jax", trial_dms=dms)
+
+    # static arm: the escape hatch — zero tuner side effects
+    prev = autotune.set_tuner(autotune.KernelTuner(mode="off"))
+    try:
+        t_static, static_wall = timed(run, n=3)
+    finally:
+        autotune.set_tuner(prev)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tuner = autotune.KernelTuner(
+            cache=TuneCache(os.path.join(tmp, "tune.json")),
+            mode="on", min_elements=0)
+        prev = autotune.set_tuner(tuner)
+        try:
+            t0 = time.perf_counter()
+            run()  # first sight of the key: measure + cache + select
+            first_wall = time.perf_counter() - t0
+            mark = autotune.decision_seq()
+            t_tuned, tuned_wall = timed(run, n=3, warmup=False)
+            steady_resolutions = len(autotune.decisions_since(mark))
+            decisions = tuner.decisions()
+            key = next(iter(decisions))
+            # None when measurement itself failed and the tuner fell
+            # back to static (nothing cached) — that's an invariant
+            # failure this config must REPORT as value 0.0, not a crash
+            entry = tuner.cache.lookup(key) or {}
+        finally:
+            autotune.set_tuner(prev)
+
+    static_kernel = autotune.static_search_kernel(jax.default_backend())
+    winner = entry.get("kernel")
+    identical = all(
+        np.array_equal(np.asarray(t_static[c]), np.asarray(t_tuned[c]))
+        for c in ("DM", "max", "std", "snr", "rebin", "peak"))
+    # on CPU the tuner must rediscover PR 1's roll-scan win by
+    # measurement; elsewhere the winner just has to be a cached one
+    winner_ok = (winner == "roll"
+                 if jax.default_backend() == "cpu" else winner is not None)
+    ok = winner_ok and identical and steady_resolutions == 0
+    measured = entry.get("measured_s") or {}
+    vs_gather = (round(measured["gather"] / measured[winner], 2)
+                 if "gather" in measured and winner in measured
+                 and measured[winner] > 0 else None)
+    emit({"config": 12, "metric": f"tuned-vs-static kernel=auto A/B, "
+          f"{nchan}x{nsamp}, {ndm} trials ({jax.default_backend()})",
+          "value": round(static_wall / tuned_wall, 4) if ok else 0.0,
+          "unit": "x (static-auto wall / tuned wall; 0 = invariant "
+                  "failure)",
+          "key": key, "winner": winner,
+          "static_kernel": static_kernel, "measured_s": measured,
+          "winner_vs_gather": vs_gather,
+          "static_wall_s": round(static_wall, 4),
+          "tuned_wall_s": round(tuned_wall, 4),
+          "first_sight_wall_s": round(first_wall, 4),
+          "steady_resolutions": steady_resolutions,
+          "tables_identical": identical})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
-                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11])
+                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -655,6 +749,16 @@ def main(argv=None):
                              "compares against a committed baseline")
     opts = parser.parse_args(argv)
     quick = os.environ.get("BENCH_PRESET") == "quick"
+    # hermetic kernel-autotune cache unless the caller set one
+    # explicitly: a full-preset run's above-floor geometries must not
+    # be steered by (or write into) the developer's personal
+    # ~/.cache tune entries — results would diverge from the committed
+    # BENCH_GATE baseline in a way no other machine reproduces
+    if "PUTPU_TUNE_CACHE" not in os.environ:
+        import tempfile
+
+        os.environ["PUTPU_TUNE_CACHE"] = os.path.join(
+            tempfile.mkdtemp(prefix="bench_tune_"), "tune_cache.json")
     try:  # persistent compile cache (big-shape compiles run minutes cold)
         import jax
 
@@ -665,7 +769,7 @@ def main(argv=None):
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11}
+           11: config11, 12: config12}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

@@ -30,6 +30,22 @@ __all__ = ["METRIC_NAMES", "BUDGET_COUNTERS", "budget_counter_metric",
 METRIC_NAMES = {
     "putpu_audit_issues_total":
         "end-of-run integrity audit inconsistencies",
+    "putpu_autotune_cache_hits_total":
+        "kernel=auto resolutions served by a remembered decision (this "
+        "process, tuned or static-fallback) or a tuned disk entry",
+    "putpu_autotune_cache_misses_total":
+        "kernel=auto resolutions with no remembered decision and no "
+        "tuned disk entry for the geometry key",
+    "putpu_autotune_equiv_rejected_total":
+        "tuning candidates rejected by the exact-hit-match harness",
+    "putpu_autotune_keys":
+        "geometry keys resolved by the kernel autotuner this process",
+    "putpu_autotune_measurements_total":
+        "tuning candidates micro-benchmarked (labelled by kernel)",
+    "putpu_autotune_speedup":
+        "last tuned key's measured static-choice/winner wall ratio",
+    "putpu_autotune_static_fallbacks_total":
+        "kernel=auto resolutions that fell back to the static heuristic",
     "putpu_bytes_readback_total":
         "bytes copied device -> host",
     "putpu_bytes_uploaded_total":
@@ -88,6 +104,10 @@ METRIC_NAMES = {
         "chunks whose best S/N cleared the threshold",
     "putpu_persist_dead_letter_total":
         "candidate persists abandoned to the dead-letter manifest",
+    "putpu_plan_cache_hits_total":
+        "geometry-keyed plan/program cache hits (labelled by cache)",
+    "putpu_plan_cache_misses_total":
+        "geometry-keyed plan/program cache misses (labelled by cache)",
     "putpu_persist_retries_total":
         "candidate persists re-attempted after OSError",
     "putpu_quarantine_records_total":

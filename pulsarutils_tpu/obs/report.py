@@ -15,6 +15,9 @@ from (the CLI folds post-run sift telemetry in this way):
 * canary: injected/recovered/recall, S/N recovery ratio, DM error,
   and the recall-vs-chunk curve;
 * budget: per-bucket seconds + share, attributed %, trips x RTT;
+* kernel autotuning: the per-geometry-key decision table (winner,
+  source, measured speedup vs the static heuristic) when
+  ``kernel="auto"`` resolved anything this run;
 * roofline: the per-kernel table when accounting ran;
 * sift + quarantine: telemetry counters and the manifest records.
 
@@ -197,6 +200,32 @@ def render_markdown(rec):
     else:
         lines += ["Roofline accounting did not run (enable with "
                   "`--trace` or `PUTPU_ROOFLINE=1`).", ""]
+
+    lines.append("## Kernel autotuning")
+    lines.append("")
+    decisions = (budget or {}).get("autotune")
+    if decisions:
+        lines.append(
+            f"{len(decisions)} `kernel=\"auto\"` geometry key(s) resolved "
+            "this run (winners persist in the tune cache; "
+            "`PUTPU_AUTOTUNE=off` restores the static heuristic):")
+        lines.append("")
+        lines.append(_md_table(
+            ("geometry key", "kernel", "source", "vs static", "detail"),
+            # the raw key's "|" separators would read as extra markdown
+            # table columns — display with a middle dot
+            [(d["key"].replace("|", "·"), d["kernel"], d["source"],
+              f"{d['speedup_vs_static']}x"
+              if d.get("speedup_vs_static") is not None else "-",
+              d.get("reason")
+              or (json.dumps(d["measured_s"])
+                  if d.get("measured_s") else "-"))
+             for d in decisions]))
+    else:
+        lines.append("No `kernel=\"auto\"` tuner resolutions this run "
+                     "(explicit kernel, `PUTPU_AUTOTUNE=off`, or no "
+                     "budget ledger).")
+    lines.append("")
 
     lines.append("## Sift")
     lines.append("")

@@ -171,7 +171,7 @@ def dedisperse_block_roll_jax(data, offsets):
     return acc
 
 
-def dedisperse_block_jax(data, offsets):
+def dedisperse_block_jax(data, offsets, formulation=None):
     """Dedisperse a block of trials on device.
 
     Parameters
@@ -181,18 +181,26 @@ def dedisperse_block_jax(data, offsets):
         dedispersion shifts wrapped into ``[0, T)`` (NOT negated: the
         negation in the reference's roll convention and the gather direction
         cancel; see module docstring).
+    formulation : ``None`` (backend-resolved, below), ``"gather"`` or
+        ``"roll"`` — forced, so the autotuner can measure both families
+        on any backend instead of trusting the static rule.
 
     Returns
     -------
     (ndm_block, T) dedispersed plane block.
 
-    Formulation is backend-resolved at trace time: the batched gather on
-    accelerators (XLA fuses it with the channel reduction), the
-    roll-accumulate scan on CPU (:func:`dedisperse_block_roll_jax` —
-    XLA:CPU scalarises the gather, measured 14x slower).
+    Default formulation is backend-resolved at trace time: the batched
+    gather on accelerators (XLA fuses it with the channel reduction),
+    the roll-accumulate scan on CPU (:func:`dedisperse_block_roll_jax`
+    — XLA:CPU scalarises the gather, measured 14x slower in PR 1; the
+    tuner now re-measures that trade per geometry instead of assuming
+    it).
     """
     jax, jnp = _jax()
-    if jax.default_backend() == "cpu":
+    if formulation is None:
+        formulation = ("roll" if jax.default_backend() == "cpu"
+                       else "gather")
+    if formulation == "roll":
         return dedisperse_block_roll_jax(data, offsets)
     t = data.shape[1]
     tidx = jnp.arange(t, dtype=jnp.int32)
@@ -202,21 +210,24 @@ def dedisperse_block_jax(data, offsets):
     return gathered.sum(axis=1)
 
 
-def dedisperse_block_chunked_jax(data, offsets, chan_block=None):
+def dedisperse_block_chunked_jax(data, offsets, chan_block=None,
+                                 formulation=None):
     """Like :func:`dedisperse_block_jax` but accumulates over channel blocks.
 
     Bounds the gather workspace to ``ndm_block * chan_block * T`` elements so
     large (nchan, T) chunks fit in HBM.  ``nchan`` must be divisible by
     ``chan_block`` (callers pad channels with zeros — zero channels are
-    exact no-ops for the sum).  On CPU the roll-accumulate formulation's
-    workspace is already ``O(ndm_block * T)``, so chunking would only add
-    loop overhead and is skipped.
+    exact no-ops for the sum).  Under the roll-accumulate formulation
+    (forced, or the CPU default) the workspace is already
+    ``O(ndm_block * T)``, so chunking would only add loop overhead and
+    is skipped.
     """
     jax, jnp = _jax()
     nchan = data.shape[0]
-    if (chan_block is None or chan_block >= nchan
-            or jax.default_backend() == "cpu"):
-        return dedisperse_block_jax(data, offsets)
+    eff = formulation or ("roll" if jax.default_backend() == "cpu"
+                          else "gather")
+    if chan_block is None or chan_block >= nchan or eff == "roll":
+        return dedisperse_block_jax(data, offsets, formulation=eff)
     assert nchan % chan_block == 0, (nchan, chan_block)
     nblocks = nchan // chan_block
     t = data.shape[1]
@@ -228,7 +239,8 @@ def dedisperse_block_chunked_jax(data, offsets, chan_block=None):
     del ndm
 
     def body(i, acc):
-        return acc + dedisperse_block_jax(data_b[i], off_b[i])
+        return acc + dedisperse_block_jax(data_b[i], off_b[i],
+                                          formulation=eff)
 
     # the carry is seeded with block 0 (not zeros): under shard_map a
     # zeros-constant carry is UNVARYING while the body's sum is varying
@@ -236,5 +248,5 @@ def dedisperse_block_chunked_jax(data, offsets, chan_block=None):
     # mismatch (hit live on a (n, 1) mesh whose per-device gather
     # exceeded the chan_block budget — round 5).  Bit-identical:
     # 0 + b0 == b0 in f32.
-    acc0 = dedisperse_block_jax(data_b[0], off_b[0])
+    acc0 = dedisperse_block_jax(data_b[0], off_b[0], formulation=eff)
     return jax.lax.fori_loop(1, nblocks, body, acc0)

@@ -301,7 +301,7 @@ def _search_numpy(data, trial_dms, start_freq, bandwidth, sample_time,
 # ---------------------------------------------------------------------------
 
 def search_kernel_fn(data, offset_blocks, capture_plane=False,
-                     chan_block=None):
+                     chan_block=None, formulation=None):
     """The pure, jittable forward step of the search (flagship kernel).
 
     ``data`` is ``(nchan, T)``; ``offset_blocks`` is
@@ -310,13 +310,16 @@ def search_kernel_fn(data, offset_blocks, capture_plane=False,
     :func:`score_profiles_stacked`) — plus the dedispersed plane blocks
     when ``capture_plane``.  Traceable under ``jit``/``shard_map``; the
     blocks are processed by ``lax.map`` so the compiled program is
-    independent of the trial count.
+    independent of the trial count.  ``formulation`` forces the
+    dedisperse formulation (``"gather"``/``"roll"``; ``None`` =
+    backend-resolved) — the axis the autotuner measures.
     """
     import jax
     import jax.numpy as jnp
 
     def per_block(offs):
-        plane = dedisperse_block_chunked_jax(data, offs, chan_block)
+        plane = dedisperse_block_chunked_jax(data, offs, chan_block,
+                                             formulation=formulation)
         scores = score_profiles_stacked(plane, xp=jnp)
         if capture_plane:
             return scores, plane
@@ -326,14 +329,15 @@ def search_kernel_fn(data, offset_blocks, capture_plane=False,
 
 
 @functools.lru_cache(maxsize=32)
-def _jax_search_kernel(capture_plane, chan_block):
+def _jax_search_kernel(capture_plane, chan_block, formulation=None):
     import jax
 
     @jax.jit
     def kernel(data, offset_blocks):
         return search_kernel_fn(data, offset_blocks,
                                 capture_plane=capture_plane,
-                                chan_block=chan_block)
+                                chan_block=chan_block,
+                                formulation=formulation)
 
     return kernel
 
@@ -544,23 +548,24 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
                            sample_time, nsamples)
 
     if kernel == "auto":
-        # the hand-written Pallas kernel is the fast path on TPU; the XLA
-        # batched gather is the portable fallback (and the CPU-test path —
-        # interpret-mode Pallas is far too slow at real sizes).  The Pallas
-        # kernel is float32-only: an explicit non-f32 dtype falls back.
-        use_pallas = (jax.default_backend() == "tpu"
-                      and dtype in (None, jnp.float32))
-        # a memmap capture needs the superblocked kernel (the gather
-        # path materialises the FULL plane inside one jitted program —
-        # the unbounded allocation the spill exists to avoid)
-        if capture_plane == "memmap":
-            use_pallas = dtype in (None, jnp.float32)
-        kernel = "pallas" if use_pallas else "gather"
-    if kernel == "gather" and capture_plane == "memmap":
+        # measured per-(backend, geometry) selection with a persistent
+        # tune cache (the PAPERS.md auto-tuning survey's lesson, made
+        # operational).  The static heuristic — Pallas on TPU, roll-scan
+        # on CPU (PR 1's measured 14x), gather elsewhere — stays as the
+        # zero-measurement fallback and the PUTPU_AUTOTUNE=off escape
+        # hatch; a winner is only ever cached after passing the
+        # exact-hit-match equivalence harness.
+        from ..tuning import autotune as _autotune
+
+        kernel = _autotune.resolve_search_kernel(
+            nchan, nsamples, ndm, dtype, capture_plane, start_freq,
+            bandwidth, sample_time, trial_dms, dm_block=dm_block,
+            chan_block=chan_block)
+    if kernel in ("gather", "roll") and capture_plane == "memmap":
         raise ValueError("capture_plane='memmap' requires the Pallas "
                          "spill path (kernel='pallas'/'auto' with the "
                          "default float32 dtype) or backend='numpy' — "
-                         "the gather kernel holds the full plane in "
+                         "the gather/roll kernels hold the full plane in "
                          "device memory, and the Pallas kernel is "
                          "float32-only")
     if kernel == "pallas":
@@ -579,7 +584,12 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
         chan_block = auto_chan_block(nchan, nsamples, dm_block)
     offset_blocks = block_offsets(offsets, dm_block)
 
-    gather_kernel = _jax_search_kernel(capture_plane, chan_block)
+    # both spellings force their formulation (an auto-resolving
+    # "gather" would make the CPU tuner measure the same program twice
+    # and never reproduce PR 1's 14x) — pre-tuner "auto" callers are
+    # unaffected because the static fallback names the formulation the
+    # old backend switch picked ("roll" on CPU, the gather elsewhere)
+    gather_kernel = _jax_search_kernel(capture_plane, chan_block, kernel)
     roof = roofline.begin()  # wall spans dispatch -> readback completion
     with budget_bucket("search/dispatch"):
         offs_dev = jnp.asarray(offset_blocks)  # attributed, not hoisted
@@ -1296,6 +1306,27 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
         peaks[blk] = p[:k]
         exact[blk] = True
 
+    _rescore_kernel = {}
+
+    def rescore_kernel():
+        """ONE tuner resolution at the CHUNK geometry (full plan ndm),
+        shared by every rescore bucket and resolved lazily on the first
+        actual rescore (a certified chunk never pays it).  Passing
+        ``kernel="auto"`` per bucket would tune independent
+        (ndm=8/16/32) keys — repeated mid-loop synthetic-chunk
+        measurements, and a bucket whose winner differed from its
+        neighbour's would diverge at float level from the
+        ``PUTPU_AUTOTUNE=off`` run.  The sharded hybrid pins its
+        ``rescore_kernel`` for the same reason."""
+        if "k" not in _rescore_kernel:
+            from ..tuning.autotune import resolve_search_kernel
+
+            _rescore_kernel["k"] = resolve_search_kernel(
+                nchan, nsamples, ndm, None, False, start_freq, bandwidth,
+                sample_time, trial_dms, dm_block=dm_block,
+                chan_block=chan_block)
+        return _rescore_kernel["k"]
+
     def rescore(rows):
         """Exact scores for ``rows`` — fused Pallas+score program on TPU
         (one dispatch + one readback per bucketed call), the portable
@@ -1318,7 +1349,8 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
                 m, s, b_, w, p, _ = _search_jax(
                     data, trial_dms[padded], start_freq, bandwidth,
                     sample_time, capture_plane=False, dm_block=dm_block,
-                    chan_block=chan_block, dtype=None, kernel="auto")
+                    chan_block=chan_block, dtype=None,
+                    kernel=rescore_kernel())
                 _apply(blk, (m, s, b_, w, p))
 
     # 2. seed (plausible-best rows + grid neighbours; the coarse grid
@@ -1427,10 +1459,18 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         tightens the miss risk at the cost of a higher
         :func:`~.certify.certifiable_snr_floor` and more rescoring.
         The value used is recorded in ``meta["cert_slack"]``.
-    kernel : JAX-path kernel selector: ``"auto"`` (Pallas on TPU, gather
-        elsewhere), ``"pallas"`` (hand-written tiled TPU kernel, see
+    kernel : JAX-path kernel selector: ``"auto"`` (measured per-
+        (backend, geometry) selection among the exact direct-sweep
+        variants via the plan-level autotuner with a persistent tune
+        cache — see :mod:`pulsarutils_tpu.tuning`; the static heuristic
+        — Pallas on TPU, roll-scan on CPU, gather elsewhere — is the
+        zero-measurement fallback and the ``PUTPU_AUTOTUNE=off`` escape
+        hatch), ``"pallas"`` (hand-written tiled TPU kernel, see
         :mod:`.pallas_dedisperse`), ``"gather"`` (portable XLA
-        ``take_along_axis`` formulation), ``"fdmt"`` (tree dedispersion,
+        ``take_along_axis`` formulation), ``"roll"`` (the roll-scan
+        scan/roll-accumulate formulation — the measured CPU winner,
+        14x over the scalarising CPU gather at the PR 1 rescore
+        geometry), ``"fdmt"`` (tree dedispersion,
         O(nchan log nchan) instead of O(ndm * nchan) — fastest for dense
         DM sweeps; uses its own integer band-delay trial grid and tree-
         rounded tracks, so hits agree with the exact kernels to within a

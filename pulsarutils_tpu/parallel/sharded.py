@@ -34,12 +34,13 @@ from ..ops.search import (
     score_profiles_stacked,
     unstack_scores,
 )
+from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
 from ..utils.logging_utils import budget_bucket, budget_count
 from ..utils.table import ResultTable
 from .mesh import pad_to_multiple
 
 
-@functools.lru_cache(maxsize=16)
+@counted_plan_cache("_sharded_kernel", maxsize=PLAN_CACHE_SIZE)
 def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
                     max_off=0):
     import jax
@@ -102,8 +103,12 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     differs.  Works on any mesh built by :mod:`.mesh`, including the
     8-virtual-device CPU mesh used in tests.
 
-    ``kernel``: ``"auto"`` (per-shard Pallas kernel on TPU meshes, XLA
-    gather elsewhere), ``"pallas"``, or ``"gather"``.
+    ``kernel``: ``"auto"`` (measured per-(backend, geometry, mesh-shape)
+    selection via the plan-level autotuner — see
+    :mod:`pulsarutils_tpu.tuning`; the static rule, per-shard Pallas on
+    all-TPU float32 meshes and XLA gather elsewhere, remains the
+    zero-measurement fallback and the ``PUTPU_AUTOTUNE=off`` escape
+    hatch), ``"pallas"``, or ``"gather"``.
 
     ``plane_handle`` (with ``capture_plane``) keeps the captured plane
     DM-sharded and device-resident, returned as a
@@ -171,9 +176,17 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
                                      nsamples, offsets.shape[0] // dm_size)
 
     if kernel == "auto":
-        kernel = ("pallas" if all(d.platform == "tpu"
-                                  for d in mesh.devices.flat)
-                  and dtype == jnp.float32 else "gather")
+        # measured per-(backend, geometry, mesh-shape) selection with the
+        # persistent tune cache; the static rule (per-shard Pallas on
+        # all-TPU float32 meshes, gather elsewhere) stays as the
+        # zero-measurement fallback and the PUTPU_AUTOTUNE=off hatch.
+        # Off-TPU meshes have a single applicable variant and resolve
+        # statically at zero cost.
+        from ..tuning.autotune import resolve_mesh_kernel
+
+        kernel = resolve_mesh_kernel(mesh, nchan, nsamples, ndm,
+                                     start_freq, bandwidth, sample_time,
+                                     trial_dms, dtype=dtype)
     # rebase wrapped offsets to the band-crossing span (see rebase_offsets)
     # so the pallas halo stays small; max_off is rounded up to a power of
     # two so small plan changes reuse the compiled kernel (the gather

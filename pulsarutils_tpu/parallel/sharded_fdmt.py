@@ -40,6 +40,7 @@ from ..ops.fdmt import (
     fdmt_plan,
     fdmt_trial_dms,
 )
+from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
 from ..utils.logging_utils import budget_bucket, budget_count
 from ..utils.table import ResultTable
 from .mesh import fetch_global, pad_to_multiple
@@ -107,7 +108,7 @@ def _stacked_tables(plans, t_tile):
     return out
 
 
-@functools.lru_cache(maxsize=8)
+@counted_plan_cache("_build_sharded_fdmt", maxsize=PLAN_CACHE_SIZE)
 def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
                         use_pallas, interpret, plan_key, t_orig,
                         with_cert=False, with_plane=False):
@@ -277,7 +278,7 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     return (table, plane_handle) if capture_plane else table
 
 
-@functools.lru_cache(maxsize=8)
+@counted_plan_cache("_plan_offsets", maxsize=PLAN_CACHE_SIZE)
 def _plan_offsets(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   nsamples):
     """Chunk-geometry plan grid + full int32 offset table, cached.
@@ -286,7 +287,11 @@ def _plan_offsets(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time,
     ``_offsets_for`` host-side on EVERY rescore bucket (and on every
     streaming chunk of identical geometry); one cached table is sliced
     per bucket instead.  Returned arrays are shared cache objects —
-    callers slice, never mutate.
+    callers slice, never mutate.  Size and hit/miss counters come from
+    :mod:`..tuning.geometry` — one documented policy for every
+    geometry-keyed plan cache (this one sat at 8 while its sibling
+    program caches sat at 16, so tuner-induced geometry churn could
+    thrash the table while the programs survived).
     """
     from ..ops.plan import dedispersion_plan
     from ..ops.search import _offsets_for
@@ -301,7 +306,7 @@ def _plan_offsets(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time,
     return trial_dms, offsets
 
 
-@functools.lru_cache(maxsize=8)
+@counted_plan_cache("_build_fused_sharded_hybrid", maxsize=PLAN_CACHE_SIZE)
 def _build_fused_sharded_hybrid(mesh, nchan, nchan_padded, t, t_tile,
                                 use_pallas, interpret, plan_key, ndm_plan,
                                 bucket, bucket2, rescore_kernel, chan_block,
@@ -545,9 +550,18 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     ndm = len(trial_dms)
 
     use_pallas = jax.default_backend() == "tpu"
-    rescore_kernel = ("pallas" if all(d.platform == "tpu"
-                                      for d in mesh.devices.flat)
-                      else "gather")
+    # the exact-rescore per-shard kernel: tuner-resolved at the chunk
+    # geometry (the same (backend, geometry, mesh) key the sharded
+    # direct sweep uses, so both paths agree on the winner); off-TPU
+    # meshes have one applicable variant and resolve statically at zero
+    # cost.  The escape-hatch rescore below passes this choice
+    # explicitly — the fused program and the hatch MUST rescore with
+    # the same per-shard kernel for the bit-identity contract
+    from ..tuning.autotune import resolve_mesh_kernel
+
+    rescore_kernel = resolve_mesh_kernel(mesh, nchan, nsamples, ndm,
+                                         start_freq, bandwidth,
+                                         sample_time, trial_dms)
     # rescore offsets aligned to the chan axis once (zero channels are
     # exact no-ops); the escape hatch gets slices of the same raw table
     # and a matching pre-padded device array, so repeat buckets never
@@ -711,6 +725,11 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
                 data_rs, dmmin, dmmax, start_freq, bandwidth, sample_time,
                 mesh=mesh, trial_dms=trial_dms[padded],
                 offsets=offsets_raw[padded],
+                # the hatch must rescore with the SAME per-shard kernel
+                # the fused program used (bit-identity contract) — an
+                # independent kernel="auto" resolution at the bucket's
+                # own geometry key could pick the other variant
+                kernel=rescore_kernel,
                 pallas_max_off=rescore_max_off)
             k = len(blk)
             _apply(blk, (np.asarray(t_ex["max"]), np.asarray(t_ex["std"]),

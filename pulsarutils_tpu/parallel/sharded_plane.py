@@ -28,10 +28,12 @@ import functools
 
 import numpy as np
 
+from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
+
 __all__ = ["ShardedPlane"]
 
 
-@functools.lru_cache(maxsize=16)
+@counted_plan_cache("_spectral_program", maxsize=PLAN_CACHE_SIZE)
 def _spectral_program(mesh, axis, tsamp, max_harmonics, fmin, fmax):
     """One jitted shard-map program: per-row spectral search of the local
     plane shard -> ``(5, rows_local)`` stacked scores (one readback)."""
@@ -67,7 +69,7 @@ def _spectral_program(mesh, axis, tsamp, max_harmonics, fmin, fmax):
                                     out_specs=P(None, axis)))
 
 
-@functools.lru_cache(maxsize=16)
+@counted_plan_cache("_h_program", maxsize=PLAN_CACHE_SIZE)
 def _h_program(mesh, axis, window, nmax):
     """Shard-local H-test per plane row (the figure's H-vs-DM curve).
 
@@ -110,7 +112,7 @@ def _h_program(mesh, axis, window, nmax):
                                     out_specs=(P(axis), P(axis))))
 
 
-@functools.lru_cache(maxsize=16)
+@counted_plan_cache("_decim_program", maxsize=PLAN_CACHE_SIZE)
 def _decim_program(mesh, axis, factor):
     """Shard-local time decimation (block sums, the reference's
     ``quick_resample`` convention) for the figure's plane panel."""

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..ops.plan import delta_delay, dm_broadening
 from ..ops.search import dedispersion_search
+from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
 from ..utils.logging_utils import budget_bucket
 
 
@@ -370,7 +371,7 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
 # Time-sharded ring dedispersion (sequence parallelism)
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=16)
+@counted_plan_cache("_ring_kernel", maxsize=PLAN_CACHE_SIZE)
 def _ring_kernel(mesh, n_hops, rotation):
     import jax
     import jax.numpy as jnp

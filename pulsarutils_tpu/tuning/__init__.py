@@ -1,0 +1,22 @@
+"""Measured kernel autotuning: per-(backend, geometry) variant selection.
+
+* :mod:`.geometry` — canonical geometry keys + the shared plan-cache
+  policy (:data:`~.geometry.PLAN_CACHE_SIZE`, hit/miss-counted lru);
+* :mod:`.cache` — the versioned persistent tune cache (torn/corrupt
+  recovery, schema gate);
+* :mod:`.autotune` — the tuner itself: measurement discipline,
+  exact-hit-match equivalence gating, the static-heuristic fallback
+  ladder and the ``PUTPU_AUTOTUNE`` escape hatch.
+
+``geometry`` stays stdlib-light and import-cheap (the parallel layers
+import it at module top for their cache decorators); everything
+JAX-adjacent lives behind function-level imports in ``autotune``.
+"""
+
+from .geometry import (  # noqa: F401
+    PLAN_CACHE_SIZE,
+    counted_plan_cache,
+    geometry_key,
+)
+
+__all__ = ["PLAN_CACHE_SIZE", "counted_plan_cache", "geometry_key"]

@@ -1,0 +1,102 @@
+"""Geometry keys + the shared plan-cache policy.
+
+Three layers grew their own per-(chunk geometry) caches — the sharded
+hybrid's ``_plan_offsets`` table, the sharded FDMT/fused program
+builders, the mesh sweep/ring kernels — and by round 10 their sizes had
+drifted (``maxsize=8`` in ``parallel/sharded_fdmt.py`` vs ``16``
+elsewhere) with no way to see whether tuner-induced geometry churn was
+evicting them.  This module is the one place that policy lives:
+
+* :data:`PLAN_CACHE_SIZE` — the documented size every geometry-keyed
+  plan/program cache uses;
+* :func:`geometry_key` — the canonical ``(backend, nchan, nsamples,
+  ndm, dtype, mesh)`` key string shared by the tune cache
+  (:mod:`.cache`) and the per-key decision tables;
+* :func:`counted_plan_cache` — ``functools.lru_cache`` with
+  hit/miss counters (``putpu_plan_cache_hits_total`` /
+  ``putpu_plan_cache_misses_total``, labelled by cache name) so
+  geometry churn is a metric, not a guess.
+
+Kept importable without JAX: the tune cache and the CLI load it on
+bare checkouts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+#: one documented size for every geometry-keyed plan/program lru cache
+#: (offset tables, sharded program builders, mesh kernels).  16 covers
+#: a streaming survey's interior + ragged-final shapes, several
+#: concurrent bench geometries and the autotuner's probe variants
+#: without eviction; the previous mix of 8 and 16 meant the sharded
+#: hybrid's plan table could thrash while its program cache did not.
+PLAN_CACHE_SIZE = 16
+
+
+def dtype_name(dtype):
+    """Canonical dtype spelling for keys (``None`` -> ``float32``, the
+    device default everywhere in this codebase)."""
+    if dtype is None:
+        return "float32"
+    name = getattr(dtype, "__name__", None) or getattr(dtype, "name", None)
+    return str(name if name is not None else dtype)
+
+
+def mesh_tag(mesh_shape):
+    """``(dm, chan)``-style mesh shape -> ``"2x4"``; ``None`` -> ``"-"``
+    (single device)."""
+    if not mesh_shape:
+        return "-"
+    return "x".join(str(int(s)) for s in mesh_shape)
+
+
+def geometry_key(backend, nchan, nsamples, ndm, dtype=None, mesh_shape=None):
+    """Canonical tune/decision key for one search geometry.
+
+    The axes are exactly the ones the auto-tuning survey (arxiv
+    1601.01165) found the fastest variant to depend on — platform,
+    channel count, series length, trial count, dtype — plus the mesh
+    shape for the sharded paths.  Stable across processes (plain
+    string), so it keys the persistent tune cache.
+    """
+    return (f"{backend}|c{int(nchan)}|t{int(nsamples)}|d{int(ndm)}"
+            f"|{dtype_name(dtype)}|m{mesh_tag(mesh_shape)}")
+
+
+def counted_plan_cache(name, maxsize=PLAN_CACHE_SIZE):
+    """``functools.lru_cache`` whose hits/misses are registry counters.
+
+    ``putpu_plan_cache_hits_total{cache=<name>}`` /
+    ``putpu_plan_cache_misses_total{cache=<name>}`` tick per call, so a
+    workload cycling more geometries than :data:`PLAN_CACHE_SIZE`
+    (tuner probes included) shows up as a miss rate instead of a silent
+    recompile storm.  The hit/miss attribution reads ``cache_info()``
+    around the call; the plan caches are only entered from the chunk
+    loop's thread, so the delta is race-free in practice (a concurrent
+    caller could at worst misattribute one hit as a miss — counters,
+    not invariants).
+    """
+
+    def deco(fn):
+        cached = functools.lru_cache(maxsize=maxsize)(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from ..obs import metrics as _metrics
+
+            before = cached.cache_info().hits
+            out = cached(*args, **kwargs)
+            if cached.cache_info().hits > before:
+                _metrics.counter("putpu_plan_cache_hits_total",
+                                 cache=name).inc()
+            else:
+                _metrics.counter("putpu_plan_cache_misses_total",
+                                 cache=name).inc()
+            return out
+
+        wrapper.cache_info = cached.cache_info
+        wrapper.cache_clear = cached.cache_clear
+        return wrapper
+
+    return deco

@@ -178,7 +178,16 @@ class BudgetAccountant(StageTimer):
         self._retrace_chunks = 0
         self._stream_chunks = 0
         self._truncation_warned = False
+        self._autotune_mark = self._autotune_seq()
         _install_compile_listener()
+
+    @staticmethod
+    def _autotune_seq():
+        """Current position in the process autotune-decision ledger
+        (lazy import: the tuning package consumes this module)."""
+        from ..tuning.autotune import decision_seq
+
+        return decision_seq()
 
     def begin_stream(self):
         """Mark the start of a new stream/run on a reused accountant.
@@ -191,6 +200,11 @@ class BudgetAccountant(StageTimer):
         """
         self._stream_chunks = 0
         self._retrace_chunks = 0  # warning escalation is per stream too
+        # per-key kernel-autotune decisions are reported per run too:
+        # the footer shows THIS stream's resolutions, not the whole
+        # process history (a reused accountant would otherwise repeat
+        # the previous run's table)
+        self._autotune_mark = self._autotune_seq()
 
     # -- per-chunk budget ----------------------------------------------------
 
@@ -358,6 +372,15 @@ class BudgetAccountant(StageTimer):
             out["rtt_s"] = round(self.rtt_s, 6)
             out["trips"] = self.trips()
             out["trips_x_rtt_s"] = round(self.trips() * self.rtt_s, 3)
+        # per-key kernel-autotune decisions since this run's
+        # begin_stream (ISSUE 7) — key absent when kernel="auto" never
+        # resolved anything this run, so pre-tuner ledgers (and the
+        # byte-pinned goldens) are unchanged
+        from ..tuning.autotune import decisions_since
+
+        decisions = decisions_since(self._autotune_mark)
+        if decisions:
+            out["autotune"] = decisions
         return out
 
     def footer(self, log=logger):
@@ -390,6 +413,11 @@ class BudgetAccountant(StageTimer):
                  j["unattributed_s"], 100.0 * j["unattributed_s"] / wall)
         if j.get("counters"):
             log.info("  counters: %s", json.dumps(j["counters"]))
+        for d in j.get("autotune", ()):
+            log.info("  autotune %s -> %s (%s%s)", d["key"], d["kernel"],
+                     d["source"],
+                     f", {d['speedup_vs_static']}x vs static"
+                     if d.get("speedup_vs_static") is not None else "")
         if self.rtt_s is not None:
             log.info("  device RTT %.4fs x %d trips = %.2fs (floor "
                      "inside the blocking buckets)", j["rtt_s"],

@@ -2,6 +2,24 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _hermetic_tune_cache(tmp_path_factory):
+    """Point the kernel-autotune cache at a per-session temp file: a
+    developer's ~/.cache tune entries must never steer test kernel
+    selection (byte-identity comparisons would diverge per machine),
+    and tests must never write the user's cache."""
+    import os
+
+    prev = os.environ.get("PUTPU_TUNE_CACHE")
+    os.environ["PUTPU_TUNE_CACHE"] = str(
+        tmp_path_factory.mktemp("tune") / "tune_cache.json")
+    yield
+    if prev is None:
+        os.environ.pop("PUTPU_TUNE_CACHE", None)
+    else:
+        os.environ["PUTPU_TUNE_CACHE"] = prev
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

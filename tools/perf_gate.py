@@ -6,8 +6,8 @@ committed baseline (direction-aware per-config headline values — see
 so the BENCH trajectory is *enforced* per PR, not just recorded.
 
 One-line CPU invocation (the committed ``BENCH_GATE_cpu.jsonl`` baseline,
-quick preset, the fast configs 1/7/10 — also wired as a ``slow``-marked
-test in ``tests/test_obs.py``):
+quick preset, the fast configs 1/7/10/11/12 — also wired as a
+``slow``-marked test in ``tests/test_obs.py``):
 
     JAX_PLATFORMS=cpu python tools/perf_gate.py
 
@@ -25,9 +25,12 @@ configs it covers — any config that emits a value record works (config
 PASS also requires the static-invariant gate: putpu-lint must report
 zero new findings (run in-process by default; point ``--lint-report``
 at a pre-generated ``putpu_lint.py --out`` JSON artifact to check that
-instead — a missing or non-clean report refuses the PASS), and every
+instead — a missing or non-clean report refuses the PASS), every
 budget-counter name in the snapshots must be declared in
-``pulsarutils_tpu/obs/names.py``.
+``pulsarutils_tpu/obs/names.py``, and the committed tune-cache
+artifact (``TUNE_cpu.json``) must carry the current
+``TUNE_SCHEMA_VERSION`` (a stale tuner schema must not pin kernel
+selection silently).
 
 Exit codes: 0 = within tolerance, 1 = regression/missing/errored
 config or lint failure, 2 = usage/baseline problems.
@@ -50,10 +53,16 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: instrumented streaming budget, 10: the canary survey — its gated
 #: value is canary RECALL, so detection-efficiency regressions fail
 #: the same gate as perf ones; 11: the putpu-lint static-invariant
-#: sweep, gated as value 1.0 = clean; all four run in tier-1-scale
-#: time)
+#: sweep, gated as value 1.0 = clean; 12: the tuned-vs-static
+#: kernel=auto A/B — its value drops to 0.0 when the autotuner's
+#: invariants break; all five run in tier-1-scale time)
 DEFAULT_BASELINE = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
-DEFAULT_CONFIGS = (1, 7, 10, 11)
+DEFAULT_CONFIGS = (1, 7, 10, 11, 12)
+
+#: the committed tune-cache artifact the gate version-checks (the
+#: snapshot-schema rule of PR 5, applied to tuner measurements: a
+#: stale schema must not silently pin kernel selection)
+DEFAULT_TUNE_ARTIFACT = os.path.join(REPO, "TUNE_cpu.json")
 
 #: per-config tolerance defaults (overridable with --tol).  The global
 #: 60% tolerance absorbs CPU wall-clock jitter, but config 10's value
@@ -62,7 +71,18 @@ DEFAULT_CONFIGS = (1, 7, 10, 11)
 #: canaries is a detection regression, not noise (one marginal canary
 #: may flip across BLAS/CPU rounding: 12/13 = 0.923 must pass, 11/13 =
 #: 0.846 must fail, so the bound sits between them).
-DEFAULT_PER_CONFIG_TOL = {10: 0.08}
+#: Configs 1 and 7 are raw wall clocks on a shared single-core runner
+#: whose load swings were MEASURED at ~3x within one session (config 1:
+#: 211-959 DM-trials/s, config 7: 0.86-2.57 s/chunk, identical code,
+#: autotuner on or off alike) — wider than the global 60% window, so
+#: they get bounds sized to fail on the 2x-10x cliffs the gate targets
+#: rather than on scheduler noise.  Config 12's value is the quotient
+#: of two jittery walls (static-auto vs tuned steady state, same
+#: kernel on CPU); its REAL gated signal is the forced 0.0 on an
+#: invariant failure (wrong winner, non-identical tables, any
+#: steady-state tuning resolution), which any tolerance catches.
+#: Config 10 stays TIGHT: canary recall is deterministic, not jittery.
+DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75}
 
 
 def run_suite(configs, preset, out_path):
@@ -118,7 +138,8 @@ def main(argv=None):
                              "the suite is run (--configs, --preset)")
     parser.add_argument("--configs", type=int, nargs="*",
                         default=list(DEFAULT_CONFIGS),
-                        help="configs to run/compare (default: 1 7 10)")
+                        help="configs to run/compare (default: "
+                             f"{' '.join(map(str, DEFAULT_CONFIGS))})")
     parser.add_argument("--preset", default="quick",
                         choices=("quick", "full"),
                         help="BENCH_PRESET when running the suite "
@@ -137,6 +158,11 @@ def main(argv=None):
     parser.add_argument("--skip-lint", action="store_true",
                         help="gate on perf only (NOT for CI: the lint "
                              "gate is part of PASS)")
+    parser.add_argument("--tune-artifact", metavar="PATH",
+                        default=DEFAULT_TUNE_ARTIFACT,
+                        help="committed tune-cache artifact to "
+                             "schema-check (default TUNE_cpu.json; "
+                             "'-' skips, NOT for CI)")
     opts = parser.parse_args(argv)
 
     if not os.path.exists(opts.baseline):
@@ -191,6 +217,19 @@ def main(argv=None):
         print(f"perf_gate: snapshot counter name(s) not declared in "
               f"obs/names.py BUDGET_COUNTERS: {', '.join(drifted)}")
         ok = False
+
+    # the committed tune-cache artifact must parse at the CURRENT
+    # schema version (the PR 5 snapshot-version rule, applied to tuner
+    # measurements): a version bump without a re-tune would leave every
+    # future run's kernel selection pinned to measurements whose
+    # meaning drifted
+    if opts.tune_artifact != "-":
+        from pulsarutils_tpu.tuning.cache import check_artifact
+
+        tune_ok, tune_detail = check_artifact(opts.tune_artifact)
+        print(f"perf_gate: tune-cache {'ok' if tune_ok else 'FAIL'} — "
+              f"{tune_detail}")
+        ok = ok and tune_ok
 
     # the lint gate: static invariants regress the same way perf does
     if opts.skip_lint:
