@@ -32,6 +32,11 @@ all). Failures in one config don't stop the others.
      static heuristic — same data, byte-identical tables required,
      zero steady-state tuning resolutions, and the CPU winner must
      reproduce PR 1's roll-scan choice by measurement
+ 13  N-beam batched vs sequential A/B (ISSUE 8): the same 3-beam
+     survey dispatched as one batched program per chunk epoch vs
+     beam-by-beam — device dispatches per beam-chunk must drop ~Nx,
+     value = sequential/batched wall per beam-chunk ratio, forced to
+     0.0 when any per-beam candidate table diverges byte-for-byte
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -738,10 +743,125 @@ def config12(quick):
           "tables_identical": identical})
 
 
+def config13(quick):
+    """N-beam batched vs sequential A/B (ISSUE 8): the multi-beam
+    subsystem's amortisation claim, measured and identity-gated.
+
+    Three same-geometry beam files (one carrying a dispersed pulse, one
+    chunk epoch hit by an all-beam synthetic RFI impulse so the
+    coincidence veto has something to veto) run twice through
+    ``multibeam_search``: sequential (one dispatch per beam-chunk) and
+    batched (ONE dispatch per chunk epoch).  The record carries
+    dispatches per beam-chunk for both arms and the coincidence
+    verdict counts; the headline ``value`` is the sequential/batched
+    wall-per-beam-chunk ratio — forced to 0.0 (far past any gate
+    tolerance) if any per-beam candidate table or ledger byte
+    diverges, because batching may change speed, never science.
+    """
+    import tempfile
+
+    from pulsarutils_tpu.beams.multibeam import multibeam_search
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.utils.logging_utils import BudgetAccountant
+
+    nbeams = 3
+    nchan, nsamples = (256, 1 << 17) if not quick else (64, 1 << 13)
+    tsamp, fbottom, bw = 0.0005, 1200.0, 200.0
+
+    def dispersed(dm, t0, amp):
+        base = np.zeros((nchan, nsamples))
+        base[:, t0] = amp
+        return disperse_array(base, dm, fbottom, bw, tsamp)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        fnames = []
+        # the SAME dispersed signal in every beam at one (DM, t): the
+        # textbook anti-coincidence case (a pointlike sky signal cannot
+        # be in all beams) — the sift must veto it as RFI
+        rfi = dispersed(150.0, nsamples // 4, 8.0)
+        # a genuinely astrophysical pulse, one beam only
+        pulse = dispersed(150.0, (3 * nsamples) // 4, 8.0)
+        for b in range(nbeams):
+            rng = np.random.default_rng(130 + b)
+            arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 10.0
+            arr = arr + rfi
+            if b == 1:
+                arr = arr + pulse
+            header = {"bandwidth": bw, "fbottom": fbottom,
+                      "nchans": nchan, "nsamples": nsamples,
+                      "tsamp": tsamp, "foff": bw / nchan}
+            path = os.path.join(tmp, f"beam{b}.fil")
+            write_simulated_filterbank(path, arr, header, descending=True,
+                                       nbeams=nbeams, ibeam=b + 1)
+            fnames.append(path)
+
+        def run(arm, batched):
+            acc = BudgetAccountant()
+            t0 = time.time()
+            res = multibeam_search(
+                fnames, 100, 200, snr_threshold=7.0,
+                output_dir=os.path.join(tmp, arm), budget=acc,
+                batched=batched, keep_tables=True, resume=True)
+            return res, acc, time.time() - t0
+
+        res_s, acc_s, wall_s = run("seq", batched=False)
+        res_b, acc_b, wall_b = run("bat", batched=True)
+
+        identical = True
+        for bb, bs in zip(res_b["beams"], res_s["beams"]):
+            if len(bb["tables"]) != len(bs["tables"]):
+                identical = False
+                break
+            for (i1, t1), (i2, t2) in zip(bb["tables"], bs["tables"]):
+                if i1 != i2 or any(
+                        not np.array_equal(t1[c], t2[c])
+                        for c in t1.colnames):
+                    identical = False
+        # union of BOTH arms' outputs: a candidate present in only one
+        # directory (e.g. a dropped persist) is a divergence too
+        names = set(os.listdir(os.path.join(tmp, "bat"))) \
+            | set(os.listdir(os.path.join(tmp, "seq")))
+        for name in sorted(names):
+            bat_path = os.path.join(tmp, "bat", name)
+            seq_path = os.path.join(tmp, "seq", name)
+            if not (os.path.exists(bat_path) and os.path.exists(seq_path)):
+                identical = False
+                continue
+            with open(bat_path, "rb") as fb, open(seq_path, "rb") as fs:
+                if fb.read() != fs.read():
+                    identical = False
+
+        epochs = len(acc_b.chunks)
+        beam_chunks = sum(b["chunks_done"] for b in res_b["beams"])
+        disp_b = acc_b.counters_total.get("dispatches", 0)
+        disp_s = acc_s.counters_total.get("dispatches", 0)
+        ratio = (wall_s / beam_chunks) / (wall_b / beam_chunks) \
+            if beam_chunks and wall_b else 0.0
+        verdicts = (res_b["coincidence"]["stats"]["verdicts"]
+                    if res_b["coincidence"] else {})
+    emit({"config": 13, "metric": f"{nbeams}-beam batched vs sequential "
+          f"A/B, {nchan}x{nsamples}, {epochs} chunk epochs",
+          "value": round(ratio, 4) if identical else 0.0,
+          "unit": "x (sequential/batched wall per beam-chunk; 0 = "
+                  "identity failure)",
+          "tables_identical": identical,
+          "dispatches_per_beam_chunk": {
+              "sequential": round(disp_s / beam_chunks, 3),
+              "batched": round(disp_b / beam_chunks, 3)},
+          "wall_per_beam_chunk_s": {
+              "sequential": round(wall_s / beam_chunks, 4),
+              "batched": round(wall_b / beam_chunks, 4)},
+          "coincidence_verdicts": verdicts,
+          "beam_hits": {str(b["beam"]): len(b["hits"])
+                        for b in res_b["beams"]}})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
-                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12])
+                        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
+                                 13])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -769,7 +889,7 @@ def main(argv=None):
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12}
+           11: config11, 12: config12, 13: config13}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

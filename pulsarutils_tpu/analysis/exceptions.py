@@ -39,6 +39,7 @@ from .core import register
 CONTAINMENT_SEAMS = {
     # -- observability must never take down a run --------------------------
     ("obs/server.py", "_Handler.do_GET"),
+    ("obs/server.py", "_Handler.do_POST"),  # job API request containment
     ("obs/server.py", "ObsServer.progress_snapshot"),  # user progress_fn
     ("obs/trace.py", "trace_session"),
     ("obs/roofline.py", "_analyze"),        # AOT lower/compile probe
@@ -57,6 +58,10 @@ CONTAINMENT_SEAMS = {
     ("pipeline/search_pipeline.py", "_search_with_fallback"),
     ("pipeline/search_pipeline.py", "search_by_chunks"),
     ("faults/policy.py", "call_with_deadline"),  # watchdog-thread relay
+    # one failed tenant batch marks its jobs FAILED; the service worker
+    # thread must survive to run the next batch (jax errors share no
+    # base class here either)
+    ("beams/service.py", "SurveyService._run_batch"),
     # -- CLI report amendment: observability never fails the run -----------
     ("cli/search_main.py", "main"),
 }

@@ -1,0 +1,32 @@
+"""Multi-beam survey subsystem (ISSUE 8).
+
+Production telescopes emit dozens-to-hundreds of beams at once; a
+hosted search service takes jobs from many users at once.  Both reduce
+to the same primitive: N same-geometry chunks stacked along a leading
+``batch`` axis and searched as ONE device dispatch — the fused
+single-dispatch hybrid (PR 2) made per-beam dispatch overhead the next
+bottleneck, and batching amortises it N ways.  Three connected pieces:
+
+* :mod:`.batcher` — :class:`~.batcher.BeamBatcher`: the stacked
+  batched dispatch, per-beam results **bit-identical** to N sequential
+  single-beam dispatches (pinned in ``tests/test_beams.py``);
+* :mod:`.multibeam` — :func:`~.multibeam.multibeam_search`: the
+  N-filterbank survey driver (per-beam resume ledgers, per-beam canary
+  injection, cross-beam coincidence sift at the end);
+* :mod:`.coincidence` — the cross-beam anti-coincidence sift: a pulse
+  in all/most beams at one (DM, time) is RFI, in 1-2 adjacent beams a
+  real detection (the PulsarX multi-stage sifting discipline applied
+  at the beam axis);
+* :mod:`.service` — :class:`~.service.SurveyService`: the
+  job-submission work queue behind the ``/jobs`` HTTP API
+  (:mod:`..obs.server`), which feeds same-geometry jobs into the
+  batcher as beams of one batched run.
+"""
+
+from .batcher import BeamBatcher, BeamGeometryError
+from .coincidence import coincidence_sift
+from .multibeam import multibeam_search
+from .service import SurveyService
+
+__all__ = ["BeamBatcher", "BeamGeometryError", "coincidence_sift",
+           "multibeam_search", "SurveyService"]

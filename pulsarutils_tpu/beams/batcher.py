@@ -1,0 +1,268 @@
+"""Batched beam dispatch: N same-geometry chunks, ONE device program.
+
+The fused single-dispatch hybrid (PR 2) collapsed a chunk's search to
+one device trip, which makes the *per-beam* trip count the next
+bottleneck: a 64-beam receiver searched beam-by-beam pays 64 dispatches
+per chunk epoch even though every beam shares one geometry, one trial
+grid and one offset table.  :class:`BeamBatcher` stacks the beams'
+chunks along a leading ``batch`` axis and runs the whole stack as ONE
+jitted program — ``lax.map`` over the beam axis of exactly the
+single-beam :func:`~pulsarutils_tpu.ops.search.search_kernel_fn` trace,
+which is what makes the bit-identity contract hold (the SPMD /
+DataParallel stacking discipline of SNIPPETS.md [2][3]):
+
+* per-beam score packs are **bit-identical** to running each beam
+  through the single-beam kernel alone (same inner computation graph,
+  same shapes, same float association — pinned for both formulations
+  in ``tests/test_beams.py``);
+* device dispatches per beam-chunk drop ~Nx (one program + one packed
+  readback per N-beam batch; bench_suite config 13 measures it);
+* the dedisperse formulation is resolved by the kernel autotuner under
+  a batch-specific geometry key (``…|b<N>`` —
+  :func:`~pulsarutils_tpu.tuning.geometry.geometry_key`), so a batched
+  winner is measured on the batched program, never assumed from the
+  single-beam one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..ops.search import _offsets_for, block_offsets, search_kernel_fn
+from ..tuning.geometry import PLAN_CACHE_SIZE
+from ..utils.logging_utils import budget_bucket, budget_count
+from ..utils.table import ResultTable
+
+__all__ = ["BeamBatcher", "BeamGeometryError", "batched_search_kernel"]
+
+
+class BeamGeometryError(ValueError):
+    """Beams offered for one batch do not share a chunk geometry."""
+
+
+@functools.lru_cache(maxsize=PLAN_CACHE_SIZE)
+def batched_search_kernel(chan_block, formulation):
+    """ONE jitted program: ``lax.map`` over the beam axis of the
+    single-beam search kernel.
+
+    Input ``data`` is ``(batch, nchan, T)``; ``offset_blocks`` the
+    shared ``(nblocks, dm_block, nchan)`` int32 table (same geometry =
+    same offsets for every beam).  Output is ``(batch, nblocks, 5,
+    dm_block)`` stacked score packs.  The per-beam body is literally
+    :func:`~pulsarutils_tpu.ops.search.search_kernel_fn` — the same
+    trace the single-beam ``_jax_search_kernel`` jits — so each beam's
+    float operations (and therefore its scores) are bit-identical to a
+    sequential single-beam run.  One compiled program serves every
+    batch width per (batch, nchan, T) shape; interior survey chunks
+    share one shape by construction, so steady state is retrace-free.
+    """
+    import jax
+
+    @jax.jit
+    def kernel(data, offset_blocks):
+        return jax.lax.map(
+            lambda beam: search_kernel_fn(beam, offset_blocks,
+                                          capture_plane=False,
+                                          chan_block=chan_block,
+                                          formulation=formulation),
+            data)
+
+    return kernel
+
+
+def batched_probe_runners(candidates, nchan, nsamples, batch, sub_dms,
+                          start_freq, bandwidth, sample_time,
+                          dm_block=None, chan_block=None):
+    """Measurement runners for the autotuner's batched-geometry key.
+
+    Builds one synthetic chunk per beam (distinct seeds, a pulse on the
+    middle probe trial's exact track — :func:`~pulsarutils_tpu.tuning.
+    autotune.synthetic_chunk`) and returns ``{kernel: run}`` where each
+    ``run()`` dispatches the REAL batched program and returns beam 0's
+    host ``(max, std, snr, window, peak)`` pack — what the tuner's
+    exact-hit-match harness compares and its clock times.
+
+    ``dm_block``/``chan_block`` must be the blocking the PRODUCTION
+    batcher will dispatch with (``BeamBatcher`` resolves chan_block via
+    ``auto_chan_block`` and passes both here through
+    ``resolve_batched_kernel``): a probe timed on an unblocked program
+    while production runs a channel-blocked one would cache a winner
+    measured on a different program.
+    """
+    import jax.numpy as jnp
+
+    from ..tuning.autotune import synthetic_chunk
+
+    sub_dms = np.asarray(sub_dms, dtype=np.float64)
+    ndm = len(sub_dms)
+    offsets = _offsets_for(sub_dms, nchan, start_freq, bandwidth,
+                           sample_time, nsamples)
+    mid = offsets[ndm // 2]
+    synth = np.stack([synthetic_chunk(nchan, nsamples, mid, seed=1601 + b)
+                      for b in range(max(int(batch), 1))])
+    if dm_block is None:
+        dm_block = 32
+    blocks = block_offsets(offsets, min(int(dm_block), ndm))
+
+    def make(kern):
+        run_kernel = batched_search_kernel(chan_block, kern)
+
+        def run():
+            out = np.asarray(run_kernel(jnp.asarray(synth),
+                                        jnp.asarray(blocks)))
+            pack = out[0].transpose(1, 0, 2).reshape(5, -1)[:, :ndm]
+            return tuple(pack[i] for i in range(5))
+
+        return run
+
+    return {k: make(k) for k in candidates}
+
+
+class BeamBatcher:
+    """Align and dispatch same-geometry chunks from N beams.
+
+    Bound to ONE chunk geometry at construction (``nchan`` channels,
+    ``nsamples`` post-resample samples, the shared ``trial_dms`` grid);
+    :meth:`search` takes the aligned per-beam blocks of one chunk epoch
+    and returns one :class:`~pulsarutils_tpu.utils.table.ResultTable`
+    per beam.  ``batch_hint`` sizes the autotuner's batched-geometry
+    measurement (the key carries it); the compiled program itself
+    serves any batch width at this geometry.
+
+    ``kernel`` forces the dedisperse formulation (``"roll"`` /
+    ``"gather"``); default resolves through the autotuner's
+    batch-keyed ladder (static fallback: roll on CPU, gather
+    elsewhere — the measured PR 1 heuristic restricted to the
+    formulations that can ride inside the batch map).
+    """
+
+    def __init__(self, nchan, nsamples, trial_dms, start_freq, bandwidth,
+                 sample_time, *, dm_block=None, chan_block=None,
+                 kernel=None, batch_hint=1):
+        self.nchan = int(nchan)
+        self.nsamples = int(nsamples)
+        self.trial_dms = np.asarray(trial_dms, dtype=np.float64)
+        self.start_freq = float(start_freq)
+        self.bandwidth = float(bandwidth)
+        self.sample_time = float(sample_time)
+        self.ndm = len(self.trial_dms)
+        if dm_block is None:
+            dm_block = max(1, min(self.ndm, 32))
+        self.dm_block = int(dm_block)
+        if chan_block is None:
+            # the single-beam sweep's auto rule (``_search_jax``):
+            # identical blocking = identical float association = the
+            # bit-identity contract extends to budget-bound geometries
+            from ..ops.search import auto_chan_block
+
+            chan_block = auto_chan_block(self.nchan, self.nsamples,
+                                         self.dm_block)
+        self.chan_block = chan_block
+        if kernel is None:
+            from ..tuning.autotune import resolve_batched_kernel
+
+            kernel = resolve_batched_kernel(
+                self.nchan, self.nsamples, self.ndm, max(int(batch_hint), 1),
+                self.start_freq, self.bandwidth, self.sample_time,
+                self.trial_dms, dm_block=self.dm_block,
+                chan_block=self.chan_block)
+        if kernel not in ("roll", "gather"):
+            raise ValueError(
+                f"BeamBatcher kernel={kernel!r}: only the traceable "
+                "formulations ('roll'/'gather') can ride inside the "
+                "batch map")
+        self.kernel = kernel
+        # per-series-length device offset tables: interior chunks share
+        # one (the bound ``nsamples``); a ragged final chunk gets its
+        # own (the gather wraps mod T, so offsets are length-specific) —
+        # both cached so steady state re-uploads nothing
+        self._offs_dev = {}
+
+    def _offsets_dev(self, nsamples):
+        import jax.numpy as jnp
+
+        dev = self._offs_dev.get(int(nsamples))
+        if dev is None:
+            offsets = _offsets_for(self.trial_dms, self.nchan,
+                                   self.start_freq, self.bandwidth,
+                                   self.sample_time, int(nsamples))
+            dev = jnp.asarray(block_offsets(offsets, self.dm_block))
+            if len(self._offs_dev) >= PLAN_CACHE_SIZE:
+                self._offs_dev.clear()  # bounded; geometries are few
+            self._offs_dev[int(nsamples)] = dev
+        return dev
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _check(self, blocks):
+        shapes = {tuple(np.shape(b)) for b in blocks}
+        if len(shapes) != 1:
+            raise BeamGeometryError(
+                f"beam blocks of one batch must share a shape; got "
+                f"{sorted(shapes)} — same-geometry chunks only")
+        shape = next(iter(shapes))
+        if len(shape) != 2 or shape[0] != self.nchan:
+            raise BeamGeometryError(
+                f"beam blocks have shape {shape}; this batcher is bound "
+                f"to {self.nchan} channels")
+        return shape[1]
+
+    def _tables(self, stacked):
+        tables = []
+        for pack in stacked:
+            pack = pack.transpose(1, 0, 2).reshape(5, -1)[:, :self.ndm]
+            maxvalues, stds, snrs = (pack[i].astype(np.float64)
+                                     for i in range(3))
+            windows = np.rint(pack[3]).astype(np.int32)
+            peaks = np.rint(pack[4]).astype(np.int64)
+            tables.append(ResultTable({
+                "DM": self.trial_dms, "max": maxvalues, "std": stds,
+                "snr": snrs, "rebin": windows, "peak": peaks}))
+        return tables
+
+    def search(self, blocks):
+        """Search one chunk epoch across all beams in ONE dispatch.
+
+        ``blocks`` is a sequence of B ``(nchan, nsamples)`` arrays (one
+        per beam, any host/device mix).  Returns B result tables whose
+        columns are bit-identical to B sequential :meth:`search_single`
+        calls.  Budget: one ``dispatches`` + one ``readbacks`` count
+        for the whole batch — that 2 vs ``2B`` trip count is the entire
+        point (config 13 gates it).
+        """
+        import jax.numpy as jnp
+
+        nsamples = self._check(blocks)
+        kernel = batched_search_kernel(self.chan_block, self.kernel)
+        with budget_bucket("search/dispatch"):
+            offs_dev = self._offsets_dev(nsamples)
+            data = jnp.stack([jnp.asarray(b, dtype=jnp.float32)
+                              for b in blocks])
+            out = kernel(data, offs_dev)
+            budget_count("dispatches")
+        with budget_bucket("search/readback"):
+            stacked = np.asarray(out)
+            budget_count("readbacks")
+        return self._tables(stacked)
+
+    def search_single(self, block):
+        """One beam through the plain single-beam compiled kernel — the
+        sequential arm of the A/B, and the bit-identity reference the
+        batched path is pinned against."""
+        import jax.numpy as jnp
+
+        from ..ops.search import _jax_search_kernel
+
+        nsamples = self._check([block])
+        kernel = _jax_search_kernel(False, self.chan_block, self.kernel)
+        with budget_bucket("search/dispatch"):
+            offs_dev = self._offsets_dev(nsamples)
+            out = kernel(jnp.asarray(block, dtype=jnp.float32),
+                         offs_dev)
+            budget_count("dispatches")
+        with budget_bucket("search/readback"):
+            stacked = np.asarray(out)
+            budget_count("readbacks")
+        return self._tables(stacked[None])[0]

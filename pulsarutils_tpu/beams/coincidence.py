@@ -1,0 +1,193 @@
+"""Cross-beam coincidence / anti-coincidence sift.
+
+A science capability that only exists at multi-beam scale: RFI enters
+the receiver *around* the dish optics, so a terrestrial impulse appears
+in **all or most beams** at the same (DM, arrival time) — while a real
+astrophysical pulse, localised on the sky, lands in **one beam** (or
+1-2 *adjacent* beams when it falls between beam centres).  Multi-stage
+candidate sifting pipelines (PulsarX, arxiv 2309.02544) apply exactly
+this discipline after the per-beam stages; this module is that stage
+over the per-beam candidate lists the multi-beam driver produces.
+
+Rules (all knobs):
+
+* a coincidence group whose members span ``>= ceil(veto_frac * nbeams)``
+  distinct beams (and at least :data:`MIN_VETO_BEAMS`) is **RFI** — the
+  anti-coincidence veto; with fewer than 3 beams total the veto never
+  fires (two beams cannot distinguish a bright sidelobe detection from
+  RFI, so the stage refuses to guess);
+* a group confined to ``<= max_real_beams`` beams that are mutually
+  **adjacent** is a **confirmed** astrophysical candidate;
+* anything between — too many beams to be pointlike, too few to veto,
+  or non-adjacent beams — is **ambiguous** (kept, flagged for a human).
+
+Grouping is the sift's greedy single-linkage in descending S/N
+(:mod:`..pipeline.sift`), applied ACROSS beams: members match on
+arrival time and DM exactly like the in-beam sift, and the per-group
+beam set drives the verdict.  Verdicts land in the coincidence metric
+family (``putpu_coincidence_groups_total`` /
+``putpu_coincidence_verdicts_total`` /
+``putpu_coincidence_vetoed_candidates_total`` — :mod:`..obs.names`),
+one ``COINCIDENCE_JSON`` footer line, and the survey report's
+coincidence section.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+from ..obs import metrics as _metrics
+from ..utils.logging_utils import logger
+
+__all__ = ["coincidence_sift", "group_summary", "RFI", "CONFIRMED",
+           "AMBIGUOUS", "MIN_VETO_BEAMS"]
+
+RFI = "rfi"
+CONFIRMED = "confirmed"
+AMBIGUOUS = "ambiguous"
+
+#: the anti-coincidence veto needs at least this many COINCIDENT beams
+#: before calling a group terrestrial, regardless of ``veto_frac`` —
+#: two beams seeing one pulse is what a real source between beam
+#: centres looks like
+MIN_VETO_BEAMS = 3
+
+
+def _adjacent(beams, adjacency):
+    """Are the group's beams mutually reachable through adjacent pairs?
+
+    ``adjacency`` maps a beam label to the set of its neighbours (a
+    receiver's beam layout); ``None`` falls back to the 1-D convention
+    — integer-labelled beams are adjacent when their labels differ by
+    1 (the sigproc ``ibeam`` numbering of a single-row receiver).  A
+    single beam is trivially adjacent.
+    """
+    beams = sorted(set(beams))
+    if len(beams) <= 1:
+        return True
+    if adjacency is not None:
+        # connectivity over the declared layout (groups are tiny)
+        seen = {beams[0]}
+        frontier = [beams[0]]
+        while frontier:
+            b = frontier.pop()
+            for nb in adjacency.get(b, ()):
+                if nb in set(beams) - seen:
+                    seen.add(nb)
+                    frontier.append(nb)
+        return seen == set(beams)
+    try:
+        labels = sorted(int(b) for b in beams)
+    except (TypeError, ValueError):
+        return False  # unknown layout, non-numeric labels: not provably adjacent
+    return all(b - a == 1 for a, b in zip(labels, labels[1:]))
+
+
+def coincidence_sift(cands, *, nbeams, time_radius=None, dm_radius=None,
+                     veto_frac=0.7, max_real_beams=2, adjacency=None,
+                     stats=None):
+    """Group per-beam candidates across beams and attach verdicts.
+
+    ``cands`` is a flat list of candidate dicts with at least ``beam``,
+    ``time``, ``dm``, ``snr`` (``width`` feeds the pair-width time
+    radius exactly as in :func:`~pulsarutils_tpu.pipeline.sift.
+    sift_candidates`); the multi-beam driver builds them with
+    :func:`~pulsarutils_tpu.pipeline.sift.hit_fields` plus the beam
+    label.  ``nbeams`` is the total beams SEARCHED (the veto fraction's
+    denominator — beams that saw nothing still count as "did not see
+    it").  ``time_radius=None`` resolves like the in-beam sift:
+    pair-width when every candidate has an exact time, 1.5x the widest
+    span otherwise.
+
+    Returns the groups (descending seed S/N), each::
+
+        {"verdict", "beams", "n_beams", "n_members", "time", "dm",
+         "snr", "members": [input dicts]}
+
+    and fills ``stats`` (optional out-param) with the in/group/verdict
+    counts that also feed the metrics and the ``COINCIDENCE_JSON``
+    footer.
+    """
+    stats = {} if stats is None else stats
+    nbeams = int(nbeams)
+    stats["in"] = len(cands)
+    stats["nbeams"] = nbeams
+    stats["verdicts"] = {RFI: 0, CONFIRMED: 0, AMBIGUOUS: 0}
+    stats["vetoed_members"] = 0
+    if time_radius is None:
+        if any(c.get("time_approx") for c in cands):
+            time_radius = 1.5 * max(c.get("span", 0.0) for c in cands)
+        else:
+            time_radius = "pair-width"
+    pair_width = time_radius == "pair-width"
+
+    groups = []
+    order = sorted(range(len(cands)), key=lambda i: -cands[i]["snr"])
+    for i in order:
+        c = cands[i]
+        for g in groups:
+            if pair_width:
+                t_radius = max(0.5, 4.0 * max(c.get("width", 0.0),
+                                              g["width"]))
+            else:
+                t_radius = time_radius
+            g_radius = (0.02 * g["dm"] + 1.0 if dm_radius is None
+                        else dm_radius)
+            if abs(c["time"] - g["time"]) <= t_radius \
+                    and abs(c["dm"] - g["dm"]) <= g_radius:
+                g["members"].append(c)
+                g["beams"].add(c["beam"])
+                break
+        else:
+            groups.append({"time": float(c["time"]), "dm": float(c["dm"]),
+                           "snr": float(c["snr"]),
+                           "width": float(c.get("width", 0.0)),
+                           "beams": {c["beam"]}, "members": [c]})
+
+    veto_min = max(MIN_VETO_BEAMS, math.ceil(float(veto_frac) * nbeams))
+    out = []
+    for g in groups:
+        n_b = len(g["beams"])
+        if nbeams >= MIN_VETO_BEAMS and n_b >= veto_min:
+            verdict = RFI
+        elif n_b <= int(max_real_beams) and _adjacent(g["beams"],
+                                                      adjacency):
+            verdict = CONFIRMED
+        else:
+            verdict = AMBIGUOUS
+        stats["verdicts"][verdict] += 1
+        if verdict == RFI:
+            stats["vetoed_members"] += len(g["members"])
+        _metrics.counter("putpu_coincidence_groups_total").inc()
+        _metrics.counter("putpu_coincidence_verdicts_total",
+                         verdict=verdict).inc()
+        out.append({"verdict": verdict,
+                    "beams": sorted(g["beams"], key=str),
+                    "n_beams": n_b, "n_members": len(g["members"]),
+                    "time": g["time"], "dm": g["dm"], "snr": g["snr"],
+                    "members": g["members"]})
+    if stats["vetoed_members"]:
+        _metrics.counter(
+            "putpu_coincidence_vetoed_candidates_total").inc(
+            stats["vetoed_members"])
+    stats["groups"] = len(out)
+    footer = {k: stats[k] for k in ("in", "nbeams", "groups", "verdicts",
+                                    "vetoed_members")}
+    logger.info("COINCIDENCE_JSON %s", json.dumps(footer))
+    return out
+
+
+def group_summary(groups, top=20):
+    """JSON-ready top-``top`` group rows for the survey report (the
+    members' info/table objects are dropped — the report is an
+    artifact, not a candidate store)."""
+    rows = []
+    for g in groups[:top]:
+        rows.append({"verdict": g["verdict"],
+                     "beams": [str(b) for b in g["beams"]],
+                     "n_members": g["n_members"],
+                     "time_s": round(float(g["time"]), 4),
+                     "dm": round(float(g["dm"]), 3),
+                     "snr": round(float(g["snr"]), 2)})
+    return rows

@@ -212,6 +212,22 @@ class FilterbankReader:
     def band_descending(self):
         return self.header["foff"] < 0
 
+    @property
+    def nbeams(self):
+        """Total beams of the observation this file belongs to (sigproc
+        ``nbeams`` header key; ``None`` when the header omits it)."""
+        n = self.header.get("nbeams")
+        return int(n) if n is not None else None
+
+    @property
+    def ibeam(self):
+        """This file's beam number (sigproc ``ibeam``, conventionally
+        1-based; ``None`` when absent).  The multi-beam driver uses it
+        to label per-beam candidates, canaries and coincidence groups
+        without re-opening files."""
+        b = self.header.get("ibeam")
+        return int(b) if b is not None else None
+
     def read_block(self, istart, nsamps, band_ascending=False):
         istart = int(istart)
         fault_inject.fire("read", chunk=istart)

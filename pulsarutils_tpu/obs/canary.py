@@ -83,7 +83,7 @@ class CanaryController:
     """
 
     def __init__(self, rate, dm=None, snr=12.0, width_s=None, seed=0,
-                 dm_tol=None, window=20):
+                 dm_tol=None, window=20, beam=None):
         if not 0.0 <= float(rate) <= 1.0:
             raise ValueError(f"canary rate {rate!r} must be in [0, 1]")
         self.rate = float(rate)
@@ -93,6 +93,22 @@ class CanaryController:
         self.seed = int(seed)
         self.dm_tol = None if dm_tol is None else float(dm_tol)
         self.window = int(window)
+        # beam label (ISSUE 8): a labelled controller injects into its
+        # OWN deterministic per-(seed, beam, chunk) subset — N beams at
+        # one seed light DIFFERENT chunks, so one silently-dead beam is
+        # caught by its own recall floor instead of averaging away —
+        # and every recall gauge/counter carries beam=<label>.
+        # beam=None keeps the exact pre-beam chunk selection and the
+        # unlabelled metric series (byte/series-identical to PR 5).
+        self.beam = beam
+        if beam is None:
+            self._beam_key = None
+        else:
+            import zlib
+
+            self._beam_key = (int(beam) if str(beam).lstrip("-").isdigit()
+                              else zlib.crc32(str(beam).encode()))
+        self._labels = {} if beam is None else {"beam": str(beam)}
         self._lock = threading.Lock()
         self._bound = False
         self._shifts = None
@@ -156,13 +172,22 @@ class CanaryController:
 
     # -- injection (reader thread) -------------------------------------------
 
+    def _rng_key(self, chunk, *extra):
+        """Seed tuple: ``(seed, chunk, ...)`` unlabelled (the PR 5
+        sequence, unchanged), ``(seed, beam_key, chunk, ...)`` per
+        beam — deterministic across resume either way."""
+        if self._beam_key is None:
+            return (self.seed, int(chunk)) + extra
+        return (self.seed, self._beam_key, int(chunk)) + extra
+
     def selects(self, chunk):
-        """Deterministic per-chunk coin flip (stable across resume)."""
+        """Deterministic per-chunk coin flip (stable across resume;
+        per-beam subset when the controller carries a beam label)."""
         if self.rate <= 0.0:
             return False
         if self.rate >= 1.0:
             return True
-        rng = np.random.default_rng((self.seed, int(chunk)))
+        rng = np.random.default_rng(self._rng_key(chunk))
         return bool(rng.random() < self.rate)
 
     def maybe_inject(self, block, chunk):
@@ -172,7 +197,7 @@ class CanaryController:
             return block
         block = np.asarray(block)
         nchan, nsamp = block.shape
-        rng = np.random.default_rng((self.seed, int(chunk), 1))
+        rng = np.random.default_rng(self._rng_key(chunk, 1))
         t0 = int(rng.integers(0, nsamp))
         # per-channel noise scale from a bounded strided subsample (the
         # reader thread must not pay a full extra pass on GB chunks)
@@ -323,21 +348,28 @@ class CanaryController:
             recall = self.recovered / self.injected
             self.curve.append((int(chunk), self.injected,
                                round(recall, 4)))
-        _metrics.counter("putpu_canary_injected_total").inc()
+        _metrics.counter("putpu_canary_injected_total",
+                         **self._labels).inc()
         if recovered:
-            _metrics.counter("putpu_canary_recovered_total").inc()
+            _metrics.counter("putpu_canary_recovered_total",
+                             **self._labels).inc()
             _metrics.histogram("putpu_canary_snr_ratio",
-                               edges=_RATIO_EDGES).observe(ratio)
+                               edges=_RATIO_EDGES,
+                               **self._labels).observe(ratio)
             _metrics.histogram("putpu_canary_dm_error",
-                               edges=_DM_ERR_EDGES).observe(abs(dm_error))
+                               edges=_DM_ERR_EDGES,
+                               **self._labels).observe(abs(dm_error))
         else:
-            _metrics.counter("putpu_canary_missed_total").inc()
-            logger.warning("canary MISSED in chunk %s: best S/N %.2f "
+            _metrics.counter("putpu_canary_missed_total",
+                             **self._labels).inc()
+            logger.warning("canary MISSED in %schunk %s: best S/N %.2f "
                            "within ±%.2f of DM %.2f (threshold %.2f)",
-                           chunk, best_snr, tol, exp["dm"],
+                           f"beam {self.beam} " if self.beam is not None
+                           else "", chunk, best_snr, tol, exp["dm"],
                            float(snr_threshold))
-        _metrics.gauge("putpu_canary_recall").set(round(recall, 4))
-        _metrics.gauge("putpu_canary_window_recall").set(
+        _metrics.gauge("putpu_canary_recall",
+                       **self._labels).set(round(recall, 4))
+        _metrics.gauge("putpu_canary_window_recall", **self._labels).set(
             round(sum(self._outcomes) / len(self._outcomes), 4))
         return {"recovered": recovered, "snr": best_snr, "ratio": ratio,
                 "dm_error": dm_error, "best_is_canary": best_is_canary,
@@ -348,7 +380,8 @@ class CanaryController:
         """The driver excluded a chunk's best row because it was this
         chunk's canary — counted, logged, never persisted (any genuine
         weaker pulse in the chunk is promoted separately)."""
-        _metrics.counter("putpu_canary_tagged_hits_total").inc()
+        _metrics.counter("putpu_canary_tagged_hits_total",
+                         **self._labels).inc()
         logger.info("canary hit in chunk %s tagged and excluded from "
                     "the candidate files/ledger", chunk)
 
@@ -358,7 +391,8 @@ class CanaryController:
         with self._lock:
             if self._pending.pop(int(chunk), None) is not None:
                 self.discarded += 1
-                _metrics.counter("putpu_canary_discarded_total").inc()
+                _metrics.counter("putpu_canary_discarded_total",
+                                 **self._labels).inc()
 
     # -- summaries -----------------------------------------------------------
 
@@ -370,6 +404,7 @@ class CanaryController:
             recovered = self.recovered
             outcomes = list(self._outcomes)
             out = {
+                **({"beam": self.beam} if self.beam is not None else {}),
                 "rate": self.rate, "dm": self.dm, "target_snr": self.snr,
                 "width_samples": self._width, "injected": injected,
                 "recovered": recovered, "discarded": self.discarded,

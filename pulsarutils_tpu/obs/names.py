@@ -46,6 +46,12 @@ METRIC_NAMES = {
         "last tuned key's measured static-choice/winner wall ratio",
     "putpu_autotune_static_fallbacks_total":
         "kernel=auto resolutions that fell back to the static heuristic",
+    "putpu_beam_chunks_total":
+        "beam-chunks completed by the multi-beam driver (labelled by "
+        "beam)",
+    "putpu_beam_hits_total":
+        "beam-chunks whose best S/N cleared the threshold (labelled by "
+        "beam)",
     "putpu_bytes_readback_total":
         "bytes copied device -> host",
     "putpu_bytes_uploaded_total":
@@ -78,6 +84,12 @@ METRIC_NAMES = {
         "chunks whose hybrid noise certificate held",
     "putpu_chunks_per_s":
         "end-of-run survey throughput",
+    "putpu_coincidence_groups_total":
+        "cross-beam coincidence groups formed",
+    "putpu_coincidence_verdicts_total":
+        "coincidence group verdicts (labelled rfi/confirmed/ambiguous)",
+    "putpu_coincidence_vetoed_candidates_total":
+        "per-beam candidates absorbed by anti-coincidence RFI vetoes",
     "putpu_chunks_quarantined_total":
         "chunks quarantined by the integrity gate",
     "putpu_chunks_sanitized_total":
@@ -102,6 +114,17 @@ METRIC_NAMES = {
         "current verdict as rank (0 OK / 1 DEGRADED / 2 CRITICAL)",
     "putpu_hits_total":
         "chunks whose best S/N cleared the threshold",
+    "putpu_job_chunks_done_total":
+        "chunks completed per service job (labelled by job id)",
+    "putpu_job_hits_total":
+        "candidates found per service job (labelled by job id)",
+    "putpu_jobs_finished_total":
+        "service jobs reaching a terminal state (labelled by status)",
+    "putpu_jobs_submitted_total":
+        "jobs accepted by the survey service",
+    "putpu_multibeam_batches_total":
+        "batched multi-beam dispatches (one device program serving N "
+        "beam-chunks)",
     "putpu_persist_dead_letter_total":
         "candidate persists abandoned to the dead-letter manifest",
     "putpu_plan_cache_hits_total":

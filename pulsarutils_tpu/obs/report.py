@@ -44,7 +44,8 @@ def _fmt(v, nd=3):
 
 
 def build_report(*, meta=None, budget=None, roofline=None, health=None,
-                 canary=None, quarantine=None, sift=None, metrics=None):
+                 canary=None, quarantine=None, sift=None, metrics=None,
+                 coincidence=None):
     """Assemble the structured report record (JSON-ready).
 
     ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
@@ -53,7 +54,9 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
     ``CanaryController.to_json()``; ``quarantine``:
     ``QuarantineManifest.records()``; ``sift``: the ``SIFT_JSON`` stats
     dict; ``metrics``: a registry snapshot list (key totals are pulled
-    out for the header).
+    out for the header); ``coincidence``: ``{"stats": COINCIDENCE_JSON
+    dict, "groups": beams.coincidence.group_summary(...) rows}`` from
+    the multi-beam driver.
     """
     rec = {
         "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -64,6 +67,7 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
         "canary": canary,
         "quarantine": quarantine or [],
         "sift": sift,
+        "coincidence": coincidence,
     }
     if metrics:
         totals = {}
@@ -237,6 +241,31 @@ def render_markdown(rec):
     else:
         lines.append("No sift telemetry (single-candidate run or sift "
                      "skipped).")
+    lines.append("")
+
+    lines.append("## Cross-beam coincidence")
+    lines.append("")
+    coinc = rec.get("coincidence")
+    if coinc:
+        stats = coinc.get("stats", {})
+        lines.append(
+            f"{stats.get('in', 0)} per-beam candidates over "
+            f"{stats.get('nbeams', '?')} beams formed "
+            f"{stats.get('groups', 0)} coincidence group(s); verdicts: `"
+            + json.dumps(stats.get("verdicts", {})) + "` "
+            f"({stats.get('vetoed_members', 0)} candidate(s) absorbed "
+            "by anti-coincidence RFI vetoes).")
+        lines.append("")
+        if coinc.get("groups"):
+            lines.append(_md_table(
+                ("verdict", "time (s)", "DM", "S/N", "beams", "members"),
+                [(g["verdict"], g.get("time_s", _fmt(g.get("time"))),
+                  g.get("dm"), g.get("snr"),
+                  ",".join(str(b) for b in g["beams"]),
+                  g["n_members"]) for g in coinc["groups"]]))
+    else:
+        lines.append("No coincidence telemetry (single-beam run or the "
+                     "cross-beam sift was skipped).")
     lines.append("")
 
     lines.append("## Quarantine manifest")

@@ -1,12 +1,18 @@
-"""Live HTTP surface: ``/metrics``, ``/healthz``, ``/progress``.
+"""Live HTTP surface: ``/metrics``, ``/healthz``, ``/progress``,
+``/jobs``.
 
 The textfile exporter (:meth:`..obs.metrics.MetricsRegistry.
 write_prometheus`) only tells the truth as of the last write; a
 multi-hour survey on a preemptible fleet needs to be scrapeable *while
-it runs*.  This module serves three read-only endpoints from a stdlib
+it runs*.  This module serves the read-only endpoints from a stdlib
 ``ThreadingHTTPServer`` on a daemon thread — no new dependencies, no
 effect on the chunk loop beyond the registry locks a scrape already
-takes:
+takes — and, when a :class:`~pulsarutils_tpu.beams.service.
+SurveyService` is wired in (ISSUE 8), the job-submission API:
+``POST /jobs`` (submit, 201 + job id; 400 on a bad spec),
+``GET /jobs`` / ``GET /jobs/<id>`` (status documents incl. per-job
+health + coincidence), ``POST /jobs/<id>/cancel``.  Read-only
+endpoints:
 
 * ``/metrics`` — the live Prometheus text exposition of the process
   registry (complementing, not replacing, the textfile route);
@@ -69,12 +75,70 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/progress":
                 self._send(200, json.dumps(srv.progress_snapshot(),
                                            indent=1), "application/json")
+            elif path == "/jobs" or path.startswith("/jobs/"):
+                self._get_jobs(srv, path)
             elif path == "/":
                 self._send(200, "pulsarutils_tpu live survey surface: "
-                           "/metrics /healthz /progress\n", "text/plain")
+                           "/metrics /healthz /progress /jobs\n",
+                           "text/plain")
             else:
                 self._send(404, "not found\n", "text/plain")
         except Exception as exc:  # a scrape must never kill the survey
+            try:
+                self._send(500, f"internal error: {exc!r}\n", "text/plain")
+            except Exception:
+                pass
+
+    def _get_jobs(self, srv, path):
+        """GET /jobs (list) and /jobs/<id> (one document)."""
+        if srv.service is None:
+            self._send(404, "no job service wired (start the server "
+                       "with service=SurveyService(...))\n", "text/plain")
+            return
+        if path == "/jobs":
+            self._send(200, json.dumps({"jobs": srv.service.jobs()},
+                                       indent=1), "application/json")
+            return
+        doc = srv.service.get(path[len("/jobs/"):])
+        if doc is None:
+            self._send(404, "unknown job\n", "text/plain")
+        else:
+            self._send(200, json.dumps(doc, indent=1), "application/json")
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        """The job-submission API (ISSUE 8): ``POST /jobs`` with a JSON
+        body ``{"fname": ..., "dmmin": ..., "dmmax": ..., ...}``
+        submits (201 + ``{"job_id": ...}``), ``POST /jobs/<id>/cancel``
+        requests cancellation.  A request must never kill the service —
+        same containment rule as the GET scrape handler."""
+        srv = self.server.obs  # type: ignore[attr-defined]
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if srv.service is None:
+                self._send(404, "no job service wired\n", "text/plain")
+                return
+            if path == "/jobs":
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    spec = json.loads(self.rfile.read(n).decode() or "{}")
+                    job_id = srv.service.submit(spec)
+                except ValueError as exc:
+                    self._send(400, json.dumps({"error": str(exc)}),
+                               "application/json")
+                    return
+                self._send(201, json.dumps({"job_id": job_id}),
+                           "application/json")
+            elif path.startswith("/jobs/") and path.endswith("/cancel"):
+                job_id = path[len("/jobs/"):-len("/cancel")]
+                doc = srv.service.cancel(job_id)
+                if doc is None:
+                    self._send(404, "unknown job\n", "text/plain")
+                else:
+                    self._send(200, json.dumps(doc, indent=1),
+                               "application/json")
+            else:
+                self._send(404, "not found\n", "text/plain")
+        except Exception as exc:  # a request must never kill the service
             try:
                 self._send(500, f"internal error: {exc!r}\n", "text/plain")
             except Exception:
@@ -92,9 +156,13 @@ class ObsServer:
     """
 
     def __init__(self, port=0, health=None, progress_fn=None,
-                 registry=None, host="127.0.0.1"):
+                 registry=None, host="127.0.0.1", service=None):
         self.health = health
         self.progress_fn = progress_fn
+        #: a :class:`~pulsarutils_tpu.beams.service.SurveyService` (or
+        #: None): wired, the surface grows the job-submission API —
+        #: POST /jobs, GET /jobs[/<id>], POST /jobs/<id>/cancel
+        self.service = service
         self.registry = registry if registry is not None \
             else _metrics.REGISTRY
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
@@ -141,12 +209,14 @@ class ObsServer:
 
 
 def start_obs_server(port, health=None, progress_fn=None, registry=None,
-                     host="127.0.0.1"):
+                     host="127.0.0.1", service=None):
     """Start the live surface; returns the :class:`ObsServer` handle
     (``handle.port`` holds the bound port — pass ``port=0`` for an
     ephemeral one).  ``host`` is the bind address: the loopback default
     keeps the surface private to the machine; pass ``"0.0.0.0"`` (or a
     specific interface) so a remote Prometheus scrape job or a fleet
-    scheduler's ``/healthz`` probe can reach it."""
+    scheduler's ``/healthz`` probe can reach it.  ``service`` (a
+    :class:`~pulsarutils_tpu.beams.service.SurveyService`) additionally
+    serves the multi-tenant job API under ``/jobs``."""
     return ObsServer(port=port, health=health, progress_fn=progress_fn,
-                     registry=registry, host=host)
+                     registry=registry, host=host, service=service)

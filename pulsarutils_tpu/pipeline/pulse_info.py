@@ -36,6 +36,11 @@ class PulseInfo:
     date: float | None = None          # MJD of observation start
     t0: float | None = None            # chunk start time (s into the file)
     istart: int | None = None          # chunk start sample in the file
+    # beam provenance (sigproc ``ibeam``/``nbeams``, ISSUE 8): carried
+    # on every candidate so the cross-beam coincidence sift and the
+    # survey report can label beams without re-opening files
+    ibeam: int | None = None
+    nbeams: int | None = None
 
     # candidate parameters
     dm: float | None = None

@@ -991,7 +991,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 allprofs=array, start_freq=start_freq, bandwidth=bandwidth,
                 nbin=array.shape[1], nchan=array.shape[0], date=date, t0=t0,
                 istart=istart,
-                pulse_freq=1.0 / (array.shape[1] * eff_tsamp))
+                pulse_freq=1.0 / (array.shape[1] * eff_tsamp),
+                # beam provenance from the sigproc header (ISSUE 8):
+                # None on single-beam files, so their persisted bytes
+                # are unchanged — beam-labelled files carry it into the
+                # candidate record for the cross-beam coincidence sift
+                ibeam=reader.ibeam, nbeams=reader.nbeams)
 
             # overlap: start chunk k+1's async upload before chunk k's
             # blocking search (see prefetch_upload)
@@ -1005,6 +1010,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     snr_floor=search_snr_floor, chunk=istart,
                     policy=dispatch_policy)
             table, plane = result if capture else (result, None)
+            if reader.ibeam is not None:
+                # chunk metadata rides the in-process table (meta is not
+                # persisted; the PulseInfo fields are the durable copy)
+                table.meta["ibeam"] = reader.ibeam
+                table.meta["nbeams"] = reader.nbeams
 
             canary_obs = (canary.observe(istart, table, snr_threshold)
                           if canary is not None else None)

@@ -62,6 +62,10 @@ def hit_fields(istart, iend, info, table):
         "dm": float(best["DM"]),
         "snr": float(best["snr"]),
         "width": float(best["rebin"]) * tsamp,
+        # beam provenance (ISSUE 8): present on candidates produced by
+        # beam-labelled files/drivers, None otherwise — the cross-beam
+        # coincidence sift keys on it
+        "beam": getattr(info, "ibeam", None),
         "info": info,
         "table": table,
     }

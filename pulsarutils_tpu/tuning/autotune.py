@@ -67,7 +67,8 @@ from .geometry import dtype_name, geometry_key
 __all__ = ["KernelTuner", "get_tuner", "set_tuner", "autotune_mode",
            "static_search_kernel", "static_mesh_kernel", "hits_match",
            "measure_kernel_wall", "resolve_search_kernel",
-           "resolve_mesh_kernel", "decision_seq", "decisions_since",
+           "resolve_mesh_kernel", "resolve_batched_kernel",
+           "decision_seq", "decisions_since",
            "MIN_TUNE_ELEMENTS", "TUNE_REPS", "TUNE_PROBE_TRIALS"]
 
 #: timed repetitions per candidate (median taken); the warm-up
@@ -354,13 +355,17 @@ class KernelTuner:
     # -- resolution ----------------------------------------------------------
 
     def resolve(self, *, backend, nchan, nsamples, ndm, dtype, candidates,
-                static, runner_factory=None, mesh_shape=None):
+                static, runner_factory=None, mesh_shape=None, batch=1):
         """One kernel name for this geometry.
 
         ``candidates`` is the constraint-filtered variant list (static
         choice first); ``runner_factory()`` lazily builds
         ``{kernel: run_callable}`` over synthetic data — only invoked
-        when a measurement is actually going to happen.
+        when a measurement is actually going to happen.  ``batch`` is
+        the beam-batch width of the multi-beam stacked dispatch (1 =
+        the classic single-beam search; the key — and therefore the
+        measured winner — is batch-specific, see
+        :func:`~.geometry.geometry_key`).
         """
         from ..obs import metrics as _metrics
 
@@ -370,7 +375,8 @@ class KernelTuner:
             # byte for byte (static not in candidates cannot happen from
             # the in-tree call sites; belt-and-braces for callers)
             return static
-        key = geometry_key(backend, nchan, nsamples, ndm, dtype, mesh_shape)
+        key = geometry_key(backend, nchan, nsamples, ndm, dtype, mesh_shape,
+                           batch=batch)
         with self._lock:
             hit = self._resolved.get(key)
         if hit is not None:
@@ -591,6 +597,50 @@ def resolve_search_kernel(nchan, nsamples, ndm, dtype, capture_plane,
         backend=backend, nchan=nchan, nsamples=nsamples, ndm=ndm,
         dtype=dtype_name(None if f32 else dtype), candidates=candidates,
         static=static, runner_factory=runner_factory)
+
+
+def resolve_batched_kernel(nchan, nsamples, ndm, batch, start_freq,
+                           bandwidth, sample_time, trial_dms,
+                           dm_block=None, chan_block=None):
+    """``kernel="auto"`` resolution for the multi-beam batched dispatch.
+
+    The beam batcher (:mod:`pulsarutils_tpu.beams.batcher`) runs the
+    dedisperse formulation per beam inside one ``lax.map``-stacked
+    program, so the candidate families are the traceable formulations
+    only — ``"roll"`` and ``"gather"`` (the Pallas kernel drives its
+    own untraced grid and cannot ride inside the batch map).  The
+    static fallback mirrors :func:`static_search_kernel` restricted to
+    that set: roll on CPU, gather elsewhere.  The geometry key carries
+    the batch width (``|b<N>``), so a batched winner never leaks into
+    single-beam resolution or vice versa; measurement runs the REAL
+    batched program over a synthetic beam stack and gates equivalence
+    on beam 0's score pack against the static formulation.
+    """
+    import jax
+
+    backend = jax.default_backend()
+    static = "roll" if backend == "cpu" else "gather"
+    candidates = [static] + [k for k in ("roll", "gather") if k != static]
+
+    def runner_factory():
+        from ..beams.batcher import batched_probe_runners
+
+        sub_dms = _probe_grid(trial_dms, get_tuner().probe_trials)
+        # the probe batch runs one synthetic chunk per beam, distinct
+        # seeds — a batched program must be timed on a batch that
+        # cannot be constant-folded into one beam's work; the runner
+        # construction (and its host readback) lives with the batcher.
+        # dm_block/chan_block are the PRODUCTION blocking: the probe
+        # must time the program the batcher will actually dispatch
+        return batched_probe_runners(candidates, nchan, nsamples, batch,
+                                     sub_dms, start_freq, bandwidth,
+                                     sample_time, dm_block=dm_block,
+                                     chan_block=chan_block)
+
+    return get_tuner().resolve(
+        backend=backend, nchan=nchan, nsamples=nsamples, ndm=ndm,
+        dtype=dtype_name(None), candidates=candidates, static=static,
+        runner_factory=runner_factory, batch=max(int(batch), 1))
 
 
 def resolve_mesh_kernel(mesh, nchan, nsamples, ndm, start_freq, bandwidth,

@@ -51,17 +51,27 @@ def mesh_tag(mesh_shape):
     return "x".join(str(int(s)) for s in mesh_shape)
 
 
-def geometry_key(backend, nchan, nsamples, ndm, dtype=None, mesh_shape=None):
+def geometry_key(backend, nchan, nsamples, ndm, dtype=None, mesh_shape=None,
+                 batch=1):
     """Canonical tune/decision key for one search geometry.
 
     The axes are exactly the ones the auto-tuning survey (arxiv
     1601.01165) found the fastest variant to depend on — platform,
     channel count, series length, trial count, dtype — plus the mesh
-    shape for the sharded paths.  Stable across processes (plain
-    string), so it keys the persistent tune cache.
+    shape for the sharded paths and, since the multi-beam subsystem
+    (ISSUE 8), the beam-batch width: a ``(batch, nchan, T)`` stacked
+    dispatch has different arithmetic intensity than ``batch``
+    single-beam dispatches, so its winner is measured under its own
+    key.  ``batch=1`` (the single-beam case) leaves the key EXACTLY as
+    before — every pre-batch tune-cache entry stays valid.  Stable
+    across processes (plain string), so it keys the persistent tune
+    cache.
     """
-    return (f"{backend}|c{int(nchan)}|t{int(nsamples)}|d{int(ndm)}"
-            f"|{dtype_name(dtype)}|m{mesh_tag(mesh_shape)}")
+    key = (f"{backend}|c{int(nchan)}|t{int(nsamples)}|d{int(ndm)}"
+           f"|{dtype_name(dtype)}|m{mesh_tag(mesh_shape)}")
+    if int(batch) > 1:
+        key += f"|b{int(batch)}"
+    return key
 
 
 def counted_plan_cache(name, maxsize=PLAN_CACHE_SIZE):

@@ -1,0 +1,217 @@
+"""Job-submission service + HTTP API lifecycle tests (ISSUE 8).
+
+Pins: submit -> running -> done over the real HTTP surface; two
+concurrent tenant jobs co-batched into one device run with correct
+per-job status and metrics labels; cancellation (queued and mid-run);
+ledger-backed exact resume of a killed job; and the PR 5 surface
+contracts (``/healthz`` 503-on-CRITICAL, no-service 404) unchanged.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.beams.service import (CANCELLED, DONE, QUEUED,
+                                           SurveyService)
+from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+from pulsarutils_tpu.obs import metrics as obs_metrics
+from pulsarutils_tpu.obs.health import HealthEngine
+from pulsarutils_tpu.obs.server import start_obs_server
+
+
+def write_file(path, nchan=64, nsamples=4096, seed=0, level=10.0):
+    rng = np.random.default_rng(seed)
+    arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + level
+    header = {"bandwidth": 200.0, "fbottom": 1200.0, "nchans": nchan,
+              "nsamples": nsamples, "tsamp": 0.0005,
+              "foff": 200.0 / nchan}
+    write_simulated_filterbank(path, arr, header, descending=True)
+    return path
+
+
+def http_get(base, path):
+    try:
+        resp = urllib.request.urlopen(base + path, timeout=10.0)
+        return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+def http_post(base, path, body=None):
+    req = urllib.request.Request(
+        base + path, method="POST",
+        data=json.dumps(body if body is not None else {}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        resp = urllib.request.urlopen(req, timeout=10.0)
+        return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+def wait_for(predicate, timeout=90.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def spec_for(fname, **kw):
+    return {"fname": fname, "dmmin": 100, "dmmax": 200,
+            "snr_threshold": 7.0, **kw}
+
+
+def test_job_lifecycle_over_http(tmp_path):
+    fname = write_file(str(tmp_path / "a.fil"))
+    with SurveyService(str(tmp_path / "svc"), batch_window_s=0.0) as svc:
+        with start_obs_server(0, service=svc) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, doc = http_post(base, "/jobs", spec_for(fname))
+            assert status == 201
+            job_id = doc["job_id"]
+            assert wait_for(lambda: http_get(
+                base, f"/jobs/{job_id}")[1]["state"] == DONE)
+            status, doc = http_get(base, f"/jobs/{job_id}")
+            assert status == 200
+            assert doc["state"] == DONE
+            assert doc["chunks_done"] > 0
+            assert doc["chunks_total"] == doc["chunks_done"]
+            assert doc["error"] is None
+            assert doc["started_at"] >= doc["submitted_at"]
+            assert doc["finished_at"] >= doc["started_at"]
+            assert doc["health"]["status"] in ("OK", "DEGRADED")
+            # the list endpoint sees it too
+            status, listing = http_get(base, "/jobs")
+            assert status == 200
+            assert [j["id"] for j in listing["jobs"]] == [job_id]
+
+
+def test_bad_submissions_are_400(tmp_path):
+    fname = write_file(str(tmp_path / "a.fil"))
+    with SurveyService(str(tmp_path / "svc")) as svc:
+        with start_obs_server(0, service=svc) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            assert http_post(base, "/jobs", {"fname": "/nope.fil",
+                                             "dmmin": 1, "dmmax": 2})[0] \
+                == 400
+            assert http_post(base, "/jobs", {"dmmin": 1})[0] == 400
+            assert http_post(base, "/jobs", {"fname": fname, "dmmin": 300,
+                                             "dmmax": 100})[0] == 400
+            assert http_get(base, "/jobs/job-999")[0] == 404
+            assert http_post(base, "/jobs/job-999/cancel")[0] == 404
+
+
+def test_two_tenant_jobs_cobatched_with_per_job_labels(tmp_path):
+    f1 = write_file(str(tmp_path / "t1.fil"), seed=1)
+    f2 = write_file(str(tmp_path / "t2.fil"), seed=2)
+    with SurveyService(str(tmp_path / "svc"), batch_window_s=0.3) as svc:
+        j1 = svc.submit(spec_for(f1))
+        j2 = svc.submit(spec_for(f2))
+        assert wait_for(lambda: svc.get(j1)["state"] == DONE
+                        and svc.get(j2)["state"] == DONE)
+        d1, d2 = svc.get(j1), svc.get(j2)
+        # co-batched: one device run served both tenants
+        assert set(d1["batch_group"]) == {j1, j2}
+        assert d1["chunks_done"] == d2["chunks_done"] > 0
+        # per-job metric labels exist and count that job's chunks
+        snap = obs_metrics.REGISTRY.snapshot()
+        per_job = {r["labels"]["job"]: r["value"] for r in snap
+                   if r["name"] == "putpu_job_chunks_done_total"
+                   and r["labels"].get("job") in (j1, j2)}
+        assert per_job[j1] >= d1["chunks_done"]
+        assert per_job[j2] >= d2["chunks_done"]
+        # cross-tenant coincidence ran over the co-batched group
+        assert d1["coincidence"] is not None
+        assert d1["coincidence"]["stats"]["nbeams"] == 2
+
+
+def test_cancel_queued_job_immediately(tmp_path):
+    fname = write_file(str(tmp_path / "a.fil"))
+    svc = SurveyService(str(tmp_path / "svc"), batch_window_s=5.0)
+    try:
+        job_id = svc.submit(spec_for(fname))
+        # still inside the batch window: the job is queued
+        doc = svc.cancel(job_id)
+        assert doc["state"] in (QUEUED, CANCELLED)
+        assert wait_for(lambda: svc.get(job_id)["state"] == CANCELLED,
+                        timeout=10.0)
+    finally:
+        svc.close()
+
+
+def test_killed_job_resumes_exactly_from_ledger(tmp_path):
+    """A job killed mid-run (cancel after N chunks) and resubmitted with
+    the same spec must resume from its ledger: the second session
+    searches only the remaining chunks and the final completion record
+    equals an uninterrupted run's."""
+    fname = write_file(str(tmp_path / "a.fil"), nsamples=16384, seed=3)
+    out = str(tmp_path / "svc")
+    with SurveyService(out, batch_window_s=0.0) as svc:
+        job_id = svc.submit(spec_for(fname))
+        # cancel as soon as a few chunks are through: cooperative, at
+        # chunk granularity — the driver stops marking new chunks
+        assert wait_for(lambda: svc.get(job_id)["chunks_done"] >= 2)
+        svc.cancel(job_id)
+        assert wait_for(lambda: svc.get(job_id)["state"]
+                        in (CANCELLED, DONE))
+        first = svc.get(job_id)
+    if first["state"] == DONE:
+        pytest.skip("job finished before the cancel landed — resume "
+                    "path not exercised on this machine")
+    done_after_kill = first["chunks_done"]
+    assert done_after_kill >= 2
+
+    with SurveyService(out, batch_window_s=0.0) as svc2:
+        job2 = svc2.submit(spec_for(fname))
+        assert wait_for(lambda: svc2.get(job2)["state"] == DONE)
+        second = svc2.get(job2)
+    # the resumed session searched strictly fewer chunks than the total,
+    # and the ledger-backed completion record covers the whole file
+    assert second["chunks_done"] == second["chunks_total"] \
+        - done_after_kill
+    assert second["chunks_total"] > second["chunks_done"]
+
+
+def test_healthz_503_on_critical_unchanged_with_service(tmp_path):
+    engine = HealthEngine(recall_min_injected=1, recall_floor=0.9)
+    # drive the engine CRITICAL via the canary recall floor
+    engine.update(0, canary={"injected": 5, "window_recall": 0.0,
+                             "window": 5})
+    with SurveyService(str(tmp_path / "svc")) as svc:
+        with start_obs_server(0, health=engine, service=svc) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, _ = http_get(base, "/healthz")
+            assert status == 503
+            # the job API coexists on the same surface
+            assert http_get(base, "/jobs")[0] == 200
+
+
+def test_jobs_endpoint_404_without_service():
+    with start_obs_server(0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert http_get(base, "/jobs")[0] == 404
+        assert http_post(base, "/jobs", {"fname": "x", "dmmin": 1,
+                                         "dmmax": 2})[0] == 404
+
+
+def test_service_worker_survives_failed_batch(tmp_path):
+    """A file that parses at submit but breaks mid-run fails ITS job;
+    the worker lives to run the next one."""
+    good = write_file(str(tmp_path / "good.fil"))
+    bad = write_file(str(tmp_path / "bad.fil"), seed=9)
+    # truncate the bad file AFTER submit-time validation would pass
+    with SurveyService(str(tmp_path / "svc"), batch_window_s=0.5) as svc:
+        jb = svc.submit(spec_for(bad))
+        with open(bad, "r+b") as f:
+            f.truncate(200)  # header survives, data gone
+        assert wait_for(lambda: svc.get(jb)["state"] != QUEUED
+                        and svc.get(jb)["state"] != "running", timeout=60)
+        jg = svc.submit(spec_for(good))
+        assert wait_for(lambda: svc.get(jg)["state"] == DONE)

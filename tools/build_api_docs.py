@@ -44,6 +44,10 @@ MODULES = [
     "pulsarutils_tpu.parallel.sharded_fdmt",
     "pulsarutils_tpu.parallel.stream",
     "pulsarutils_tpu.parallel.multihost",
+    "pulsarutils_tpu.beams.batcher",
+    "pulsarutils_tpu.beams.multibeam",
+    "pulsarutils_tpu.beams.coincidence",
+    "pulsarutils_tpu.beams.service",
     "pulsarutils_tpu.io.sigproc",
     "pulsarutils_tpu.io.lowbit",
     "pulsarutils_tpu.io.candidates",
