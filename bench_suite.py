@@ -37,6 +37,12 @@ all). Failures in one config don't stop the others.
      beam-by-beam — device dispatches per beam-chunk must drop ~Nx,
      value = sequential/batched wall per beam-chunk ratio, forced to
      0.0 when any per-beam candidate table diverges byte-for-byte
+ 14  2-worker fleet vs single-process A/B (ISSUE 9): the same
+     multi-file survey run single-process and then through a
+     coordinator + two workers over the real /fleet/ wire protocol —
+     value = single-process/fleet wall ratio, forced to 0.0 when any
+     per-file ledger or candidate byte diverges (the fleet may change
+     speed, never science)
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -857,11 +863,140 @@ def config13(quick):
                         for b in res_b["beams"]}})
 
 
+def config14(quick):
+    """2-worker fleet vs single-process A/B (ISSUE 9): the PR 4/8
+    house rule applied to horizontal scale-out, measured and
+    identity-gated over the REAL wire.
+
+    A two-file survey (one file carrying a dispersed pulse) runs
+    single-process (``search_by_chunks`` per file), then again through
+    a :class:`~pulsarutils_tpu.fleet.coordinator.FleetCoordinator` +
+    two :class:`~pulsarutils_tpu.fleet.worker.FleetWorker` threads
+    speaking the HTTP ``/fleet/`` protocol — every lease, completion
+    and ledger resolution is the production path, only the transport
+    hop is loopback.  The headline ``value`` is the single-process /
+    fleet wall ratio (~1 on a single-core CPU runner, where two
+    workers just interleave; the number that must never silently
+    regress is the dispatch math, and identity is the gate) — forced
+    to 0.0, far past any tolerance, when any per-file ledger byte or
+    candidate npz member diverges between the two runs, or the fleet
+    fails to finish the survey.
+    """
+    import glob
+    import tempfile
+    import threading
+
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.obs.server import start_obs_server
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+    tsamp, nchan = 0.0005, 64
+    hop = 4096 if quick else 8192
+    nhops = 6
+    nsamples = nhops * hop
+    config = dict(dmmin=100, dmmax=200, chunk_length=hop * tsamp,
+                  snr_threshold=6.5)
+    with tempfile.TemporaryDirectory() as tmp:
+        fnames = []
+        for i in range(2):
+            rng = np.random.default_rng(140 + i)
+            arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+            if i == 0:
+                arr[:, (3 * nsamples) // 4] += 4.0
+                arr = disperse_array(arr, 150.0, 1200., 200., tsamp)
+            header = {"bandwidth": 200., "fbottom": 1200.,
+                      "nchans": nchan, "nsamples": nsamples,
+                      "tsamp": tsamp, "foff": 200. / nchan}
+            path = os.path.join(tmp, f"survey{i}.fil")
+            write_simulated_filterbank(path, arr, header,
+                                       descending=True)
+            fnames.append(path)
+
+        single_dir = os.path.join(tmp, "single")
+        t0 = time.time()
+        for fname in fnames:
+            search_by_chunks(fname, output_dir=single_dir,
+                             make_plots=False, progress=False, **config)
+        single_wall = time.time() - t0
+
+        fleet_dir = os.path.join(tmp, "fleet")
+        t0 = time.time()
+        coordinator = FleetCoordinator(fleet_dir, lease_ttl_s=120.0,
+                                       chunks_per_unit=1,
+                                       probe_interval_s=0.5)
+        server = start_obs_server(0, fleet=coordinator)
+        url = f"http://127.0.0.1:{server.port}"
+        coordinator.add_survey(fnames, **config)
+        workers = [FleetWorker(url, http_port=None) for _ in range(2)]
+        threads = [threading.Thread(target=w.run,
+                                    kwargs={"max_idle_s": 120.0})
+                   for w in workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600.0)
+        fleet_wall = time.time() - t0
+        progress = coordinator.progress_doc()
+        server.close()
+        coordinator.close()
+
+        # identity: per-file ledger raw bytes + candidate npz member
+        # bytes (the chaos-drill comparison rule — zip timestamps are
+        # the only allowed whole-file difference)
+        identical = progress["survey_done"]
+        names = {os.path.basename(p) for d in (single_dir, fleet_dir)
+                 for p in glob.glob(os.path.join(d, "progress_*.json"))
+                 + glob.glob(os.path.join(d, "*.npz"))}
+        for name in sorted(names):
+            a_path = os.path.join(single_dir, name)
+            b_path = os.path.join(fleet_dir, name)
+            if not (os.path.exists(a_path) and os.path.exists(b_path)):
+                identical = False
+                log(f"config 14: {name} present in only one arm")
+                continue
+            if name.endswith(".json"):
+                with open(a_path, "rb") as fa, open(b_path, "rb") as fb:
+                    if fa.read() != fb.read():
+                        identical = False
+                        log(f"config 14: ledger bytes differ: {name}")
+            else:
+                with np.load(a_path, allow_pickle=False) as za, \
+                        np.load(b_path, allow_pickle=False) as zb:
+                    if set(za.files) != set(zb.files) or any(
+                            za[k].tobytes() != zb[k].tobytes()
+                            or za[k].dtype != zb[k].dtype
+                            or za[k].shape != zb[k].shape
+                            for k in za.files):
+                        identical = False
+                        log(f"config 14: candidate bytes differ: {name}")
+
+    ratio = single_wall / fleet_wall if fleet_wall else 0.0
+    emit({"config": 14, "metric": "2-worker fleet vs single-process "
+          f"A/B, 2 files x {nchan}x{nsamples}, "
+          f"{progress['chunks_total']} chunks over the /fleet/ wire "
+          "protocol",
+          "value": round(ratio, 4) if identical else 0.0,
+          "unit": "x (single-process/fleet wall; 0 = identity or "
+                  "completion failure)",
+          "identical": identical,
+          "survey_done": progress["survey_done"],
+          "chunks_total": progress["chunks_total"],
+          "chunks_done": progress["chunks_done"],
+          "units": progress["units"],
+          "lease_stats": progress["stats"],
+          "units_per_worker": [w.units_done for w in workers],
+          "single_wall_s": round(single_wall, 2),
+          "fleet_wall_s": round(fleet_wall, 2)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13])
+                                 13, 14])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -889,7 +1024,7 @@ def main(argv=None):
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13}
+           11: config11, 12: config12, 13: config13, 14: config14}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

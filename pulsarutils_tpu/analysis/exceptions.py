@@ -62,6 +62,13 @@ CONTAINMENT_SEAMS = {
     # thread must survive to run the next batch (jax errors share no
     # base class here either)
     ("beams/service.py", "SurveyService._run_batch"),
+    # a poisoned leased unit reports its error string and the
+    # coordinator requeues (bounded by max_attempts); the fleet worker
+    # must survive to lease the next unit (jax errors again) — the
+    # reviewed fleet containment seam (ISSUE 9; the coordinator's HTTP
+    # handlers ride the already-seamed obs/server do_GET/do_POST, and
+    # the drain path catches only (OSError, ValueError) narrowly)
+    ("fleet/worker.py", "FleetWorker._run_unit"),
     # -- CLI report amendment: observability never fails the run -----------
     ("cli/search_main.py", "main"),
 }

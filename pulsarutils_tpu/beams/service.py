@@ -44,8 +44,8 @@ from ..obs import metrics as _metrics
 from ..obs.health import HealthEngine
 from ..utils.logging_utils import logger
 
-__all__ = ["SurveyService", "JobSpec", "QUEUED", "RUNNING", "DONE",
-           "FAILED", "CANCELLED"]
+__all__ = ["SurveyService", "JobSpec", "validate_spec", "QUEUED",
+           "RUNNING", "DONE", "FAILED", "CANCELLED"]
 
 QUEUED = "queued"
 RUNNING = "running"
@@ -66,6 +66,33 @@ def JobSpec(fname, dmmin, dmmax, **knobs):
     for key in _FORWARD_KEYS:
         if key in knobs and knobs[key] is not None:
             spec[key] = knobs[key]
+    return spec
+
+
+def validate_spec(spec):
+    """Validate + normalise a ``POST /jobs``-shaped job spec; raises
+    ``ValueError`` on a bad one (the HTTP layer maps that to a 400).
+
+    The job-handoff seam (ISSUE 9): ONE set of submission rules shared
+    by the in-process :class:`SurveyService` and the fleet
+    coordinator's :meth:`~pulsarutils_tpu.fleet.coordinator.
+    FleetCoordinator.add_job` — a spec either deployment accepts is
+    valid in the other, so routing jobs from a single-host service to
+    a worker fleet is a deployment decision, not a format migration.
+    """
+    if not isinstance(spec, dict):
+        raise ValueError("job spec must be a JSON object")
+    missing = {"fname", "dmmin", "dmmax"} - set(spec)
+    if missing:
+        raise ValueError(f"job spec missing keys: {sorted(missing)}")
+    spec = JobSpec(**{k: spec[k] for k in
+                      ({"fname", "dmmin", "dmmax"} | set(_FORWARD_KEYS))
+                      & set(spec)})
+    if not os.path.exists(spec["fname"]):
+        raise ValueError(f"no such file: {spec['fname']}")
+    if not spec["dmmin"] < spec["dmmax"]:
+        raise ValueError(
+            f"dmmin {spec['dmmin']} must be < dmmax {spec['dmmax']}")
     return spec
 
 
@@ -168,20 +195,10 @@ class SurveyService:
     def submit(self, spec):
         """Queue a job; returns its id.  Raises ``ValueError`` on a bad
         spec (missing/unreadable file, inverted DM range) — the HTTP
-        layer maps that to a 400."""
-        if not isinstance(spec, dict):
-            raise ValueError("job spec must be a JSON object")
-        missing = {"fname", "dmmin", "dmmax"} - set(spec)
-        if missing:
-            raise ValueError(f"job spec missing keys: {sorted(missing)}")
-        spec = JobSpec(**{k: spec[k] for k in
-                          ({"fname", "dmmin", "dmmax"} | set(_FORWARD_KEYS))
-                          & set(spec)})
-        if not os.path.exists(spec["fname"]):
-            raise ValueError(f"no such file: {spec['fname']}")
-        if not spec["dmmin"] < spec["dmmax"]:
-            raise ValueError(
-                f"dmmin {spec['dmmin']} must be < dmmax {spec['dmmax']}")
+        layer maps that to a 400.  Validation rules live in
+        :func:`validate_spec`, shared with the fleet coordinator's job
+        handoff."""
+        spec = validate_spec(spec)
         # header must parse at submit time — and the batchability tag it
         # yields is cached on the job so batch pops never touch disk
         geom_tag = (_geometry_tag(spec["fname"]),

@@ -1,0 +1,171 @@
+"""``PUfleet`` — run either role of the survey fleet (ISSUE 9).
+
+Coordinator (shards files, serves the wire protocol + ``/fleet/``
+endpoints, steals work from sick workers, exits when the survey is
+done)::
+
+    PUfleet coordinator obs1.fil obs2.fil --output-dir out \\
+        --http-port 8900 --dmmin 100 --dmmax 200
+
+Worker (leases units, searches them through the hardened driver,
+reports completions; SIGTERM/SIGINT drain gracefully)::
+
+    PUfleet worker --coordinator http://cohost:8900 --http-port 0
+
+The two roles share ``--output-dir`` through a common filesystem — the
+per-file exact-resume ledgers there are the fleet's completion record.
+See ``docs/fleet.md`` for the deployment model and failure matrix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ..utils.logging_utils import logger
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="PUfleet",
+        description="Coordinator/worker fleet for horizontally scaled "
+                    "surveys (lease-based work-stealing over the "
+                    "exact-resume ledger).")
+    sub = parser.add_subparsers(dest="role", required=True)
+
+    coord = sub.add_parser("coordinator",
+                           help="shard files into leased units and "
+                                "serve the fleet protocol")
+    coord.add_argument("fnames", nargs="+",
+                       help="filterbank files to shard across the fleet")
+    coord.add_argument("--output-dir", required=True,
+                       help="shared directory for ledgers + candidates "
+                            "(every worker must see the same files)")
+    coord.add_argument("--http-port", type=int, required=True,
+                       help="coordinator surface port (0 = ephemeral, "
+                            "printed at startup)")
+    coord.add_argument("--http-host", default="127.0.0.1",
+                       help="bind address; 0.0.0.0 exposes the "
+                            "coordinator to remote workers")
+    coord.add_argument("--dmmin", type=float, default=300.0)
+    coord.add_argument("--dmmax", type=float, default=400.0)
+    coord.add_argument("--snr-threshold", default=None,
+                       help="number, 'auto' or 'certifiable' "
+                            "(driver default when omitted)")
+    coord.add_argument("--kernel", default=None)
+    coord.add_argument("--chunk-length", type=float, default=None)
+    coord.add_argument("--lease-ttl", type=float, default=60.0,
+                       help="seconds a silent worker keeps a lease")
+    coord.add_argument("--chunks-per-unit", type=int, default=1)
+    coord.add_argument("--probe-interval", type=float, default=2.0,
+                       help="seconds between /healthz probe sweeps")
+    coord.add_argument("--no-resume", action="store_true",
+                       help="shard every chunk even when ledgers "
+                            "already mark some done")
+    coord.add_argument("--report-out", default=None,
+                       help="write the end-of-run survey report (with "
+                            "the fleet section) to this base path")
+    coord.add_argument("--exit-when-done", action="store_true",
+                       help="exit once every unit is resolved (default: "
+                            "keep serving so more surveys can be added)")
+
+    work = sub.add_parser("worker",
+                          help="lease and search units from a "
+                               "coordinator")
+    work.add_argument("--coordinator", required=True,
+                      help="coordinator base URL, e.g. "
+                           "http://cohost:8900")
+    work.add_argument("--http-port", type=int, default=0,
+                      help="this worker's live surface port (0 = "
+                           "ephemeral; the coordinator probes its "
+                           "/healthz for lease gating)")
+    work.add_argument("--http-host", default="127.0.0.1")
+    work.add_argument("--worker-id", default=None,
+                      help="stable id (default: coordinator-assigned)")
+    work.add_argument("--max-units", type=int, default=1,
+                      help="units per lease request")
+    work.add_argument("--max-idle", type=float, default=None,
+                      help="exit after this many seconds with nothing "
+                           "to lease (default: poll forever)")
+    return parser
+
+
+def _run_coordinator(opts):
+    from ..fleet.coordinator import FleetCoordinator
+    from ..obs.server import start_obs_server
+
+    config = {"dmmin": opts.dmmin, "dmmax": opts.dmmax}
+    if opts.snr_threshold is not None:
+        try:
+            config["snr_threshold"] = float(opts.snr_threshold)
+        except ValueError:
+            config["snr_threshold"] = opts.snr_threshold
+    if opts.kernel is not None:
+        config["kernel"] = opts.kernel
+    if opts.chunk_length is not None:
+        config["chunk_length"] = opts.chunk_length
+
+    coordinator = FleetCoordinator(
+        opts.output_dir, lease_ttl_s=opts.lease_ttl,
+        chunks_per_unit=opts.chunks_per_unit,
+        probe_interval_s=opts.probe_interval,
+        resume=not opts.no_resume)
+    server = start_obs_server(opts.http_port, host=opts.http_host,
+                              fleet=coordinator)
+    logger.info("fleet coordinator on http://%s:%d — workers: "
+                "PUfleet worker --coordinator http://%s:%d",
+                opts.http_host, server.port, opts.http_host, server.port)
+    coordinator.add_survey(opts.fnames, **config)
+    try:
+        while True:
+            time.sleep(1.0)
+            if opts.exit_when_done and coordinator.survey_done:
+                logger.info("fleet: survey complete")
+                break
+    except KeyboardInterrupt:
+        logger.info("fleet coordinator shutting down")
+    finally:
+        summary = coordinator.summary()
+        server.close()
+        coordinator.close()
+    print(json.dumps({"fleet": summary}))
+    if opts.report_out:
+        from ..obs import metrics as obs_metrics
+        from ..obs.report import write_report
+
+        write_report(opts.report_out,
+                     meta={"root": "fleet",
+                           "files": len(opts.fnames),
+                           "output_dir": os.path.abspath(opts.output_dir)},
+                     fleet=summary,
+                     metrics=obs_metrics.REGISTRY.snapshot())
+        logger.info("fleet report -> %s.md", opts.report_out)
+    return 0 if summary["survey_done"] else 1
+
+
+def _run_worker(opts):
+    from ..fleet.worker import FleetWorker
+
+    worker = FleetWorker(opts.coordinator, worker_id=opts.worker_id,
+                         http_port=opts.http_port,
+                         http_host=opts.http_host,
+                         max_units=opts.max_units)
+    worker.install_signal_handlers()
+    units = worker.run(max_idle_s=opts.max_idle)
+    print(json.dumps({"worker": worker.worker_id, "units_done": units,
+                      "drained": worker.drained}))
+    return 0
+
+
+def main(argv=None):
+    opts = build_parser().parse_args(argv)
+    if opts.role == "coordinator":
+        return _run_coordinator(opts)
+    return _run_worker(opts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,15 @@ site       seam                                                 kinds
 ``dispatch``the per-chunk device search dispatch                ``error``, ``hang``
 ``mesh``   the sharded multi-device route inside the dispatch   ``error``, ``hang``
 ``persist````CandidateStore.save_candidate``                    ``error``
+``fleet``  ``FleetWorker._run_unit`` (per leased unit; ISSUE 9) ``error``, ``hang``
 ========== ==================================================== ==========================
+
+The ``fleet`` site fires *inside the worker*, before a leased unit's
+``search_by_chunks`` session starts — ``kind="hang"`` wedges a worker
+so the coordinator's lease TTL + health probes must steal the unit,
+``kind="error"`` makes the unit fail and requeue; both drive the chaos
+drill's killed/wedged-worker classes (the ``chunk`` selector matches
+the unit's first leased chunk).
 
 Arming: ``with plan.armed(): ...`` (tests, the chaos drill), or export
 ``PUTPU_FAULT_PLAN`` with the plan's JSON — the env form survives a

@@ -1,0 +1,26 @@
+"""Horizontally scaled survey orchestration (ISSUE 9).
+
+A coordinator/worker fleet that shards a survey — many filterbank files
+x chunk ranges — into **leased work units** over a JSON wire protocol,
+composing the single-process hardening primitives across processes and
+hosts:
+
+* :mod:`.protocol` — the wire messages, the search-config whitelist a
+  lease may carry, and the tiny urllib JSON client the worker uses;
+* :mod:`.coordinator` — :class:`~.coordinator.FleetCoordinator`: unit
+  sharding via :func:`~pulsarutils_tpu.pipeline.search_pipeline.
+  plan_survey`, lease TTLs, health-probed work-stealing, and each
+  file's exact-resume ledger as the *shared completion record*;
+* :mod:`.worker` — :class:`~.worker.FleetWorker`: wraps
+  ``search_by_chunks`` per leased unit, reports completions with its
+  metrics snapshot + health verdict, and drains gracefully on
+  SIGTERM/SIGINT.
+
+See ``docs/fleet.md`` for the deployment model and the lease/steal
+failure matrix.
+"""
+
+from .coordinator import FleetCoordinator
+from .worker import FleetWorker
+
+__all__ = ["FleetCoordinator", "FleetWorker"]

@@ -1,0 +1,778 @@
+"""The fleet coordinator: lease-based work-stealing over the ledger.
+
+:class:`FleetCoordinator` shards a survey — many files x chunk ranges —
+into leased work units and hands them to workers over the JSON wire
+protocol (:mod:`.protocol`), composing the single-process hardening
+primitives across processes:
+
+* **sharding** uses :func:`~pulsarutils_tpu.pipeline.search_pipeline.
+  plan_survey`, the same function ``search_by_chunks`` plans from, so
+  the coordinator's chunk grid and ledger fingerprint are *definitionally*
+  the worker's — no protocol for agreeing on geometry, just one code
+  path;
+* **the ledger is the completion record** — every grant, completion and
+  requeue re-reads the file's exact-resume ledger
+  (:class:`~pulsarutils_tpu.io.candidates.CandidateStore` format) from
+  the shared filesystem.  Lease expiry, worker death and duplicate
+  completions are all resolved by the ledger's idempotent chunk-keyed
+  semantics: a chunk is done iff the ledger says so, a re-searched chunk
+  rewrites identical bytes, and the queue is never trusted;
+* **work-stealing is health-probed** — the sweep loop polls each
+  worker's ``/healthz`` (:mod:`~pulsarutils_tpu.obs.health` verdicts):
+  DEGRADED workers stop receiving leases (they finish what they hold),
+  CRITICAL and dead (N consecutive probe failures) workers have their
+  leases revoked and requeued immediately; expired leases requeue the
+  chunks the ledger still shows missing.
+
+The HTTP surface rides the existing :class:`~pulsarutils_tpu.obs.
+server.ObsServer` (``start_obs_server(..., fleet=coordinator)``):
+``GET /fleet/workers`` / ``/fleet/leases`` / ``/fleet/progress`` and
+the fleet-aggregated ``GET /fleet/metrics`` (every worker's last
+reported registry snapshot re-exposed as one Prometheus page with a
+``worker`` label), plus the four POST messages of the protocol.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+from ..obs import metrics as _metrics
+from ..utils.logging_utils import logger
+from . import protocol
+
+__all__ = ["FleetCoordinator"]
+
+#: lease/steal failure matrix states (documented in docs/fleet.md)
+_TERMINAL = ("done", "failed")
+
+
+class _Unit:
+    """One leasable work unit: a chunk range of one file.  ``chunks``
+    only ever shrinks (grant-time ledger check drops finished ones)."""
+
+    __slots__ = ("id", "fname", "chunks", "attempts", "state")
+
+    def __init__(self, unit_id, fname, chunks):
+        self.id = unit_id
+        self.fname = fname
+        self.chunks = tuple(int(c) for c in chunks)
+        self.attempts = 0
+        self.state = "pending"      # pending | leased | done | failed
+
+    def doc(self):
+        return {"unit": self.id, "fname": self.fname,
+                "chunks": list(self.chunks), "state": self.state,
+                "attempts": self.attempts}
+
+
+class _Lease:
+    __slots__ = ("id", "unit_id", "worker_id", "expires_at", "granted_at")
+
+    def __init__(self, lease_id, unit_id, worker_id, expires_at):
+        self.id = lease_id
+        self.unit_id = unit_id
+        self.worker_id = worker_id
+        self.expires_at = expires_at      # monotonic deadline
+        self.granted_at = time.time()
+
+
+class _WorkerRec:
+    __slots__ = ("id", "healthz_url", "verdict", "probe_failures",
+                 "alive", "draining", "last_seen", "units_completed",
+                 "metrics", "registered_at")
+
+    def __init__(self, worker_id, healthz_url):
+        self.id = worker_id
+        self.healthz_url = healthz_url
+        self.verdict = "OK"
+        self.probe_failures = 0
+        self.alive = True
+        self.draining = False
+        self.last_seen = time.time()
+        self.units_completed = 0
+        self.metrics = None       # last reported registry snapshot
+        self.registered_at = time.time()
+
+    def doc(self, held):
+        return {"worker": self.id, "healthz_url": self.healthz_url,
+                "verdict": self.verdict, "alive": self.alive,
+                "draining": self.draining,
+                "probe_failures": self.probe_failures,
+                "last_seen": round(self.last_seen, 3),
+                "units_completed": self.units_completed,
+                "leases_held": held}
+
+
+class FleetCoordinator:
+    """Shard surveys into leased units; steal work from sick workers.
+
+    ``output_dir`` must be a filesystem every worker shares — it holds
+    the per-file ledgers (the completion record) and candidates.
+    ``lease_ttl_s`` bounds how long a silent worker keeps a unit;
+    ``chunks_per_unit`` sizes units (1 = finest stealing granularity,
+    larger amortises per-unit driver startup); ``dead_after`` is the
+    consecutive-probe-failure count that declares a worker dead;
+    ``file_affinity=True`` (default) grants units of one file to one
+    worker at a time, so concurrent ledger writers only exist in the
+    work-stealing edge (see ``CandidateStore.mark_done``'s merge rule);
+    ``max_attempts`` bounds requeues per unit before it is marked
+    failed (a chunk that kills every worker must not starve the fleet).
+
+    ``auto_sweep=True`` runs lease expiry + health probes on a daemon
+    thread every ``probe_interval_s``; tests pass ``False`` and drive
+    :meth:`sweep` deterministically.
+    """
+
+    def __init__(self, output_dir, *, lease_ttl_s=30.0, chunks_per_unit=1,
+                 probe_interval_s=1.0, probe_timeout_s=2.0, dead_after=3,
+                 poll_s=0.25, resume=True, file_affinity=True,
+                 max_attempts=5, auto_sweep=True):
+        self.output_dir = str(output_dir)
+        os.makedirs(self.output_dir, exist_ok=True)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.chunks_per_unit = max(int(chunks_per_unit), 1)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.dead_after = int(dead_after)
+        self.poll_s = float(poll_s)
+        self.resume = bool(resume)
+        self.file_affinity = bool(file_affinity)
+        self.max_attempts = int(max_attempts)
+        self._lock = threading.Lock()
+        self._units = {}          # unit_id -> _Unit
+        self._pending = []        # unit ids, FIFO (requeues jump the line)
+        self._leases = {}         # lease_id -> _Lease
+        self._workers = {}        # worker_id -> _WorkerRec
+        self._files = {}          # fname -> {"fingerprint", "config", ...}
+        self._seq = {"unit": 0, "lease": 0, "worker": 0}
+        self._stats = {"granted": 0, "expired": 0, "revoked": 0,
+                       "denied": 0, "requeued": 0, "completed": 0,
+                       "failed": 0, "duplicates": 0}
+        self._closed = False
+        self._sweeper = None
+        if auto_sweep:
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="fleet-sweep", daemon=True)
+            self._sweeper.start()
+
+    # -- survey intake -------------------------------------------------------
+
+    def add_survey(self, fnames, **config):
+        """Shard ``fnames`` into work units under one search config.
+
+        ``config`` is the :data:`~.protocol.SEARCH_KEYS` subset of
+        ``search_by_chunks`` keywords; it is planned *here* (via
+        ``plan_survey``) and shipped verbatim in every lease, so worker
+        sessions land on exactly the planned ledger fingerprint.  With
+        ``resume=True`` (the default) chunks the ledgers already mark
+        done are never sharded at all.  Returns the new unit ids.
+        """
+        import inspect
+
+        from ..pipeline.search_pipeline import plan_survey, search_by_chunks
+
+        config = protocol.clean_search_config(config)
+        # plan with the WORKER's effective defaults: keys the lease
+        # omits resolve from search_by_chunks' own signature, never
+        # from plan_survey's — so a future default edit in the driver
+        # cannot silently fork coordinator and worker onto different
+        # fingerprints (they'd disagree on every completion)
+        plan_params = set(inspect.signature(plan_survey).parameters) \
+            - {"fname"}
+        driver_defaults = {
+            k: p.default for k, p in
+            inspect.signature(search_by_chunks).parameters.items()
+            if k in plan_params and p.default is not inspect.Parameter.empty}
+        plan_config = dict(
+            driver_defaults,
+            **{k: v for k, v in config.items() if k in plan_params})
+        planned = []
+        for fname in fnames:
+            fname = os.path.abspath(str(fname))
+            sp = plan_survey(fname, **plan_config)
+            done = self._read_ledger_done(sp["fingerprint"]) \
+                if self.resume else set()
+            starts = [s for s in sp["chunk_starts"] if s not in done]
+            planned.append((fname, sp, starts))
+        ids = []
+        with self._lock:
+            for fname, sp, starts in planned:
+                if fname in self._files \
+                        and self._files[fname]["fingerprint"] \
+                        != sp["fingerprint"]:
+                    raise ValueError(
+                        f"{fname} is already sharded under a different "
+                        "search config — one fleet run, one fingerprint "
+                        "per file")
+                self._files[fname] = {
+                    "fingerprint": sp["fingerprint"], "config": config,
+                    "root": sp["root"],
+                    "chunks_total": len(sp["chunk_starts"]),
+                    "chunk_starts": list(sp["chunk_starts"])}
+                for i in range(0, len(starts), self.chunks_per_unit):
+                    self._seq["unit"] += 1
+                    unit = _Unit(f"u{self._seq['unit']}", fname,
+                                 starts[i:i + self.chunks_per_unit])
+                    self._units[unit.id] = unit
+                    self._pending.append(unit.id)
+                    ids.append(unit.id)
+                logger.info(
+                    "fleet: sharded %s into %d unit(s) (%d of %d chunks "
+                    "pending, fingerprint %s)", os.path.basename(fname),
+                    -(-len(starts) // self.chunks_per_unit), len(starts),
+                    len(sp["chunk_starts"]), sp["fingerprint"])
+            self._update_gauges_locked()
+        return ids
+
+    def add_job(self, spec):
+        """The job-handoff seam from the multi-tenant service: shard one
+        ``POST /jobs``-shaped spec (validated by
+        :func:`~pulsarutils_tpu.beams.service.validate_spec` — the same
+        rules the in-process :class:`~pulsarutils_tpu.beams.service.
+        SurveyService` applies) into fleet units.  Multibeam-only knobs
+        (``canary_rate``, ``veto_frac``, ``max_real_beams``,
+        ``max_chunks``) are rejected explicitly: the fleet shards plain
+        per-file surveys, and silently dropping a requested knob would
+        misrepresent what ran.
+        """
+        from ..beams.service import validate_spec
+
+        spec = validate_spec(spec)
+        unsupported = sorted(
+            set(spec) & {"canary_rate", "veto_frac", "max_real_beams",
+                         "max_chunks"})
+        if unsupported:
+            raise ValueError(
+                f"job spec keys {unsupported} are multibeam-service "
+                "knobs the fleet does not run — submit to the service, "
+                "or drop them")
+        config = {k: v for k, v in spec.items() if k != "fname"}
+        return self.add_survey([spec["fname"]], **config)
+
+    # -- the ledger: the only completion record ------------------------------
+
+    def _read_ledger_done(self, fingerprint):
+        """The ``done`` chunk set of one ledger, straight off disk.
+
+        A plain read, not a :class:`CandidateStore` (constructing one
+        backs torn files up as ``.corrupt`` — a *recovery* side effect
+        the coordinator's read-only resolution must not trigger; the
+        audit reads non-destructively for the same reason).  Unreadable
+        or torn state resolves to "nothing done": the worst case is an
+        idempotent re-search, never a lost chunk.
+        """
+        path = os.path.join(self.output_dir,
+                            f"progress_{fingerprint}.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return set()
+        done = doc.get("done") if isinstance(doc, dict) else None
+        if not isinstance(done, list):
+            return set()
+        return {int(c) for c in done if isinstance(c, int)}
+
+    def _ledger_remaining(self, unit, done_cache):
+        fingerprint = self._files[unit.fname]["fingerprint"]
+        if fingerprint not in done_cache:
+            done_cache[fingerprint] = self._read_ledger_done(fingerprint)
+        done = done_cache[fingerprint]
+        return tuple(c for c in unit.chunks if c not in done)
+
+    # -- protocol handlers (the obs server routes /fleet/ POSTs here) --------
+
+    def register(self, doc):
+        """``register`` message: admit a worker, hand it the fleet
+        parameters.  ``healthz_url`` is optional — a worker without one
+        is never probed and lives/dies by lease TTL alone."""
+        healthz = doc.get("healthz_url") if isinstance(doc, dict) else None
+        if healthz is not None and not isinstance(healthz, str):
+            raise ValueError("healthz_url must be a string or null")
+        requested = doc.get("worker") if isinstance(doc, dict) else None
+        with self._lock:
+            if self._closed:
+                raise ValueError("coordinator is shut down")
+            if requested is not None:
+                worker_id = str(requested)
+                if worker_id in self._workers:
+                    raise ValueError(
+                        f"worker id {worker_id!r} is already registered")
+            else:
+                self._seq["worker"] += 1
+                worker_id = f"w{self._seq['worker']}"
+            self._workers[worker_id] = _WorkerRec(worker_id, healthz)
+            self._update_gauges_locked()
+        logger.info("fleet: worker %s registered (healthz: %s)",
+                    worker_id, healthz or "none — TTL liveness only")
+        return {"worker": worker_id, "lease_ttl_s": self.lease_ttl_s,
+                "poll_s": self.poll_s,
+                "protocol_version": protocol.PROTOCOL_VERSION}
+
+    def lease(self, doc):
+        """``lease`` message: grant up to ``max_units`` pending units.
+
+        Health gate: a DEGRADED/CRITICAL worker is denied (it keeps
+        draining what it holds; CRITICAL additionally gets its leases
+        revoked by the sweep).  Every granted unit is ledger-checked
+        first — chunks another session finished are dropped before they
+        are leased, so a requeued duplicate can never double-search.
+        """
+        worker_id = str(protocol.require(doc, "worker", str, "lease"))
+        max_units = int(doc.get("max_units", 1))
+        done_cache = {}
+        with self._lock:
+            worker = self._workers.get(worker_id)
+            if worker is None:
+                raise ValueError(f"unknown worker {worker_id!r} — "
+                                 "register first")
+            worker.last_seen = time.time()
+            # a lease request IS liveness: a worker the prober declared
+            # dead but which is demonstrably talking gets revived (its
+            # old leases were already requeued; it simply starts fresh)
+            worker.alive = True
+            worker.probe_failures = 0
+            # ...and carries a health self-report, so a denied worker
+            # whose transient conditions decayed can recover without
+            # waiting for a probe (unprobed workers have no other path
+            # back); the independent /healthz probe still overrides on
+            # its own cadence — a wedged worker cannot self-report
+            self._note_report_locked(worker, doc)
+            if worker.draining or self._closed:
+                return {"leases": [], "denied": "draining",
+                        "survey_done": self._survey_done_locked(),
+                        "poll_s": self.poll_s}
+            if worker.verdict in ("DEGRADED", "CRITICAL"):
+                self._stats["denied"] += 1
+                _metrics.counter("putpu_fleet_leases_denied_total").inc()
+                logger.info("fleet: lease denied to %s (verdict %s)",
+                            worker_id, worker.verdict)
+                return {"leases": [], "denied": worker.verdict,
+                        "survey_done": self._survey_done_locked(),
+                        "poll_s": self.poll_s}
+            granted = self._grant_locked(worker, max_units, done_cache)
+            self._update_gauges_locked()
+            return {"leases": granted, "denied": None,
+                    "survey_done": self._survey_done_locked(),
+                    "poll_s": self.poll_s}
+
+    def _note_report_locked(self, worker, doc):
+        """Fold a message's optional self-reported ``metrics`` snapshot
+        and ``health`` verdict into the worker record."""
+        if isinstance(doc.get("metrics"), list):
+            worker.metrics = doc["metrics"]
+        health = doc.get("health")
+        if isinstance(health, dict) and "status" in health:
+            worker.verdict = str(health["status"])
+
+    def _grant_locked(self, worker, max_units, done_cache):
+        granted = []
+        busy = {}
+        if self.file_affinity:
+            for lease in self._leases.values():
+                busy[self._units[lease.unit_id].fname] = lease.worker_id
+        for unit_id in list(self._pending):
+            if len(granted) >= max_units:
+                break
+            unit = self._units[unit_id]
+            if busy.get(unit.fname, worker.id) != worker.id:
+                continue   # another worker holds this file's ledger pen
+            remaining = self._ledger_remaining(unit, done_cache)
+            if not remaining:
+                # finished out-of-band (a duplicate's late write, a
+                # resumed local run): the ledger says done, so it is
+                self._pending.remove(unit_id)
+                self._finish_unit_locked(unit)
+                continue
+            unit.chunks = remaining
+            unit.state = "leased"
+            self._pending.remove(unit_id)
+            self._seq["lease"] += 1
+            lease = _Lease(f"L{self._seq['lease']}", unit_id, worker.id,
+                           time.monotonic() + self.lease_ttl_s)
+            self._leases[lease.id] = lease
+            busy.setdefault(unit.fname, worker.id)
+            self._stats["granted"] += 1
+            _metrics.counter("putpu_fleet_leases_granted_total").inc()
+            rec = self._files[unit.fname]
+            granted.append({
+                "lease": lease.id, "unit": unit.id, "fname": unit.fname,
+                "chunks": list(unit.chunks), "config": rec["config"],
+                "output_dir": self.output_dir,
+                "expires_in_s": self.lease_ttl_s})
+        return granted
+
+    def complete(self, doc):
+        """``complete`` message: resolve a finished (or failed) unit.
+
+        The report is advisory; the ledger decides.  Chunks the ledger
+        still shows missing are requeued (``requeued`` in the reply
+        names them); a completion for an already-resolved lease — the
+        expired-and-stolen straggler — is counted as a duplicate and
+        resolved the same way.  The worker's registry snapshot and
+        health verdict ride along for ``/fleet/metrics`` and
+        ``/fleet/workers``.
+        """
+        worker_id = str(protocol.require(doc, "worker", str, "complete"))
+        lease_id = str(protocol.require(doc, "lease", str, "complete"))
+        unit_id = str(protocol.require(doc, "unit", str, "complete"))
+        error = doc.get("error")
+        done_cache = {}
+        with self._lock:
+            worker = self._workers.get(worker_id)
+            if worker is not None:
+                worker.last_seen = time.time()
+                self._note_report_locked(worker, doc)
+            unit = self._units.get(unit_id)
+            if unit is None:
+                raise ValueError(f"unknown unit {unit_id!r}")
+            lease = self._leases.get(lease_id)
+            if lease is not None and lease.unit_id == unit_id:
+                del self._leases[lease_id]
+            else:
+                # the lease was already expired/revoked and possibly
+                # re-granted: the straggler finished anyway.  Its ledger
+                # writes are idempotent; all we do is count it.
+                self._stats["duplicates"] += 1
+                _metrics.counter(
+                    "putpu_fleet_duplicate_completions_total").inc()
+                logger.info(
+                    "fleet: duplicate completion of %s by %s (lease %s "
+                    "already resolved)", unit_id, worker_id, lease_id)
+            if error is not None:
+                requeued = self._requeue_locked(unit, done_cache,
+                                                why=f"error: {error}")
+                self._update_gauges_locked()
+                return {"ok": True, "unit_done": unit.state == "done",
+                        "requeued": list(requeued),
+                        "survey_done": self._survey_done_locked()}
+            remaining = self._ledger_remaining(unit, done_cache)
+            if remaining:
+                # claimed complete, ledger disagrees: a drain-truncated
+                # unit (the worker says so — cooperative, no attempt
+                # burned) or a lost write / lying worker (counted);
+                # either way requeue exactly the missing chunks
+                drained = bool(doc.get("drained"))
+                requeued = self._requeue_locked(
+                    unit, done_cache,
+                    why=("drain-truncated unit" if drained
+                         else "completion not backed by the ledger"),
+                    count_attempt=not drained)
+            else:
+                requeued = ()
+                if unit.state != "done":
+                    if unit.id in self._pending:  # requeued duplicate
+                        self._pending.remove(unit.id)
+                    self._finish_unit_locked(unit)
+                if worker is not None:
+                    worker.units_completed += 1
+            self._update_gauges_locked()
+            return {"ok": True, "unit_done": unit.state == "done",
+                    "requeued": list(requeued),
+                    "survey_done": self._survey_done_locked()}
+
+    def release(self, doc):
+        """``release`` message: a draining worker returns leases it has
+        not started (its in-flight unit finishes normally and arrives
+        as a ``complete``).  The worker is marked draining — no further
+        grants — and every returned unit is ledger-checked back into
+        the queue."""
+        worker_id = str(protocol.require(doc, "worker", str, "release"))
+        lease_ids = protocol.require(doc, "leases", list, "release")
+        reason = str(doc.get("reason", "drain"))
+        done_cache = {}
+        requeued = 0
+        with self._lock:
+            worker = self._workers.get(worker_id)
+            if worker is not None:
+                worker.last_seen = time.time()
+                worker.draining = True
+            for lease_id in lease_ids:
+                lease = self._leases.pop(str(lease_id), None)
+                if lease is None or lease.worker_id != worker_id:
+                    continue
+                unit = self._units[lease.unit_id]
+                requeued += bool(self._requeue_locked(
+                    unit, done_cache, why=f"released ({reason})",
+                    count_attempt=False))
+            self._update_gauges_locked()
+        logger.info("fleet: %s released %d lease(s) (%s)", worker_id,
+                    len(lease_ids), reason)
+        return {"ok": True, "requeued": requeued}
+
+    # -- requeue / unit lifecycle (call with the lock held) ------------------
+
+    def _finish_unit_locked(self, unit):
+        unit.state = "done"
+        self._stats["completed"] += 1
+        _metrics.counter("putpu_fleet_units_completed_total").inc()
+
+    def _requeue_locked(self, unit, done_cache, why="",
+                        count_attempt=True):
+        """Put a unit's ledger-missing chunks back in the queue (at the
+        front: stolen work is the oldest work).  Returns the requeued
+        chunk tuple (empty = the ledger says everything is done).
+
+        ``count_attempt=False`` for *cooperative* returns — a drain's
+        released or truncated units: the ``max_attempts`` bound exists
+        to stop a poison chunk that keeps killing workers (errors,
+        expiries, revokes), and routine preemption churn must never
+        burn it down into silent coverage holes.
+        """
+        remaining = self._ledger_remaining(unit, done_cache)
+        if not remaining:
+            if unit.id in self._pending:
+                self._pending.remove(unit.id)
+            if unit.state not in _TERMINAL:
+                self._finish_unit_locked(unit)
+            return ()
+        unit.chunks = remaining
+        if count_attempt:
+            unit.attempts += 1
+        if unit.attempts >= self.max_attempts:
+            unit.state = "failed"
+            if unit.id in self._pending:
+                self._pending.remove(unit.id)
+            self._stats["failed"] += 1
+            _metrics.counter("putpu_fleet_units_failed_total").inc()
+            logger.error(
+                "fleet: unit %s (%s chunks %s) FAILED after %d attempts "
+                "(%s) — chunks stay unsearched, see /fleet/progress",
+                unit.id, os.path.basename(unit.fname), list(remaining),
+                unit.attempts, why)
+            return ()
+        unit.state = "pending"
+        if unit.id not in self._pending:
+            self._pending.insert(0, unit.id)
+        self._stats["requeued"] += 1
+        _metrics.counter("putpu_fleet_units_requeued_total").inc()
+        logger.warning("fleet: requeued unit %s chunks %s (%s, attempt "
+                       "%d/%d)", unit.id, list(remaining), why,
+                       unit.attempts, self.max_attempts)
+        return remaining
+
+    def _survey_done_locked(self):
+        return bool(self._units) and not self._pending \
+            and not self._leases \
+            and all(u.state in _TERMINAL for u in self._units.values())
+
+    def _update_gauges_locked(self):
+        _metrics.gauge("putpu_fleet_units_pending").set(
+            len(self._pending))
+        _metrics.gauge("putpu_fleet_workers").set(
+            sum(1 for w in self._workers.values() if w.alive))
+
+    # -- the sweep: lease expiry + health-probed stealing --------------------
+
+    def sweep(self, now=None):
+        """One expiry + probe pass (the auto-sweep thread calls this
+        every ``probe_interval_s``; tests call it directly).  ``now``
+        overrides the monotonic clock for deterministic expiry tests.
+        Returns a summary dict of what the pass did."""
+        now = time.monotonic() if now is None else now
+        done_cache = {}
+        expired = []
+        with self._lock:
+            for lease_id, lease in list(self._leases.items()):
+                if lease.expires_at <= now:
+                    del self._leases[lease_id]
+                    unit = self._units[lease.unit_id]
+                    self._stats["expired"] += 1
+                    _metrics.counter(
+                        "putpu_fleet_leases_expired_total").inc()
+                    self._requeue_locked(
+                        unit, done_cache,
+                        why=f"lease {lease_id} on {lease.worker_id} "
+                        "expired")
+                    expired.append(lease_id)
+            probe_targets = [(w.id, w.healthz_url)
+                             for w in self._workers.values()
+                             if w.alive and w.healthz_url]
+        probes = {}
+        for worker_id, url in probe_targets:   # IO outside the lock
+            probes[worker_id] = self._probe_one(url)
+        revoked = []
+        with self._lock:
+            for worker_id, verdict in probes.items():
+                worker = self._workers.get(worker_id)
+                if worker is None or not worker.alive:
+                    continue
+                if verdict is None:
+                    worker.probe_failures += 1
+                    if worker.probe_failures >= self.dead_after:
+                        worker.alive = False
+                        logger.warning(
+                            "fleet: worker %s declared DEAD after %d "
+                            "failed probes — revoking its leases",
+                            worker_id, worker.probe_failures)
+                        revoked += self._revoke_worker_locked(
+                            worker_id, done_cache, "worker dead")
+                else:
+                    worker.probe_failures = 0
+                    worker.verdict = verdict
+                    if verdict == "CRITICAL":
+                        revoked += self._revoke_worker_locked(
+                            worker_id, done_cache, "verdict CRITICAL")
+            self._update_gauges_locked()
+        return {"expired": expired, "revoked": revoked,
+                "probed": {w: v for w, v in probes.items()}}
+
+    def _probe_one(self, url):
+        """One ``/healthz`` probe; the verdict string, or ``None`` when
+        the worker is unreachable (transport error, junk response)."""
+        try:
+            _status, doc = protocol.get_json(
+                url, timeout=self.probe_timeout_s)
+            verdict = doc.get("status")
+            return str(verdict) if verdict is not None else None
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+
+    def _revoke_worker_locked(self, worker_id, done_cache, why):
+        revoked = []
+        for lease_id, lease in list(self._leases.items()):
+            if lease.worker_id != worker_id:
+                continue
+            del self._leases[lease_id]
+            self._stats["revoked"] += 1
+            _metrics.counter("putpu_fleet_leases_revoked_total").inc()
+            self._requeue_locked(self._units[lease.unit_id], done_cache,
+                                 why=f"revoked from {worker_id}: {why}")
+            revoked.append(lease_id)
+        return revoked
+
+    def _sweep_loop(self):
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                self.sweep()
+            except (OSError, ValueError, KeyError) as exc:
+                # a sweep pass must not kill the thread that does the
+                # stealing; anything outside these is a bug and should
+                logger.warning("fleet: sweep pass failed (%r)", exc)
+            time.sleep(self.probe_interval_s)
+
+    # -- the read surface (GET /fleet/...) -----------------------------------
+
+    def workers_doc(self):
+        with self._lock:
+            held = {}
+            for lease in self._leases.values():
+                held[lease.worker_id] = held.get(lease.worker_id, 0) + 1
+            return {"workers": [w.doc(held.get(w.id, 0))
+                                for w in sorted(self._workers.values(),
+                                                key=lambda w: w.id)]}
+
+    def leases_doc(self):
+        now = time.monotonic()
+        with self._lock:
+            return {"leases": [
+                {"lease": lease.id, "worker": lease.worker_id,
+                 "unit": lease.unit_id,
+                 "fname": self._units[lease.unit_id].fname,
+                 "chunks": list(self._units[lease.unit_id].chunks),
+                 "expires_in_s": round(lease.expires_at - now, 3),
+                 "granted_at": round(lease.granted_at, 3)}
+                for lease in sorted(self._leases.values(),
+                                    key=lambda le: le.id)]}
+
+    def progress_doc(self):
+        """The ``/fleet/progress`` document: per-file ledger-derived
+        chunk completion plus unit/worker/stat rollups."""
+        with self._lock:
+            files = []
+            for fname, rec in sorted(self._files.items()):
+                done = self._read_ledger_done(rec["fingerprint"])
+                planned = set(rec["chunk_starts"])
+                files.append({
+                    "fname": fname, "fingerprint": rec["fingerprint"],
+                    "chunks_total": rec["chunks_total"],
+                    "chunks_done": len(done & planned)})
+            states = {}
+            for unit in self._units.values():
+                states[unit.state] = states.get(unit.state, 0) + 1
+            return {
+                "files": files,
+                "chunks_total": sum(f["chunks_total"] for f in files),
+                "chunks_done": sum(f["chunks_done"] for f in files),
+                "units": states,
+                "workers": {"registered": len(self._workers),
+                            "alive": sum(1 for w in
+                                         self._workers.values()
+                                         if w.alive)},
+                "stats": dict(self._stats),
+                "survey_done": self._survey_done_locked()}
+
+    def fleet_metrics_text(self):
+        """The fleet-aggregated ``/fleet/metrics`` Prometheus page:
+        every worker's last reported registry snapshot, re-exposed with
+        a ``worker`` label.  Counter/gauge samples only — histogram
+        series are per-worker detail a fleet operator scrapes from the
+        worker's own ``/metrics``."""
+        from ..obs.metrics import _fmt_labels
+
+        with self._lock:
+            snapshots = [(w.id, w.metrics)
+                         for w in sorted(self._workers.values(),
+                                         key=lambda w: w.id)
+                         if w.metrics]
+        typed = {}
+        samples = []
+        for worker_id, snap in snapshots:
+            for rec in snap:
+                if rec.get("type") not in ("counter", "gauge") \
+                        or "value" not in rec:
+                    continue
+                name = rec["name"]
+                typed.setdefault(name, rec["type"])
+                labels = dict(rec.get("labels") or {})
+                labels["worker"] = worker_id
+                samples.append(
+                    (name, _fmt_labels(sorted(labels.items())),
+                     rec["value"]))
+        lines = []
+        seen = set()
+        for name, label_str, value in sorted(samples):
+            if name not in seen:
+                seen.add(name)
+                lines.append(f"# TYPE {name} {typed[name]}")
+            lines.append(f"{name}{label_str} {value}")
+        return "\n".join(lines) + "\n"
+
+    def summary(self):
+        """Condensed end-of-run record (the survey report's fleet
+        section and the CLI's final log line)."""
+        doc = self.progress_doc()
+        with self._lock:
+            workers = [w.doc(0) for w in sorted(self._workers.values(),
+                                                key=lambda w: w.id)]
+        return {"chunks_total": doc["chunks_total"],
+                "chunks_done": doc["chunks_done"],
+                "units": doc["units"], "stats": doc["stats"],
+                "survey_done": doc["survey_done"],
+                "workers": [{k: w[k] for k in
+                             ("worker", "verdict", "alive",
+                              "units_completed")} for w in workers]}
+
+    @property
+    def survey_done(self):
+        with self._lock:
+            return self._survey_done_locked()
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=self.probe_interval_s + 5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,143 @@
+"""The fleet wire protocol: JSON messages over plain HTTP.
+
+Four POST messages drive the whole fleet (served by the coordinator's
+:mod:`~pulsarutils_tpu.obs.server` surface under ``/fleet/``):
+
+========== ============================================================
+message    body
+========== ============================================================
+register   ``{"healthz_url": str|null, "worker": str|null}`` ->
+           ``{"worker": id, "lease_ttl_s", "poll_s",
+           "protocol_version"}``
+lease      ``{"worker": id, "max_units": n, "health": {verdict
+           doc}|absent}`` -> ``{"leases": [{
+           "lease", "unit", "fname", "chunks", "config",
+           "output_dir", "expires_in_s"}], "denied": str|null,
+           "survey_done": bool, "poll_s": float}``
+complete   ``{"worker", "lease", "unit", "error": str|null,
+           "metrics": [registry snapshot], "health": {verdict doc}}``
+           -> ``{"ok", "unit_done", "requeued": [chunks],
+           "survey_done"}``
+release    ``{"worker", "leases": [ids], "reason": str}`` ->
+           ``{"ok", "requeued": n}`` (graceful drain: unstarted
+           leases go back to the queue, the worker gets no more)
+========== ============================================================
+
+Design rules:
+
+* **the queue is advisory, the ledger is truth** — nothing in these
+  messages is trusted for completion; the coordinator re-reads the
+  per-file resume ledger at every grant, completion and requeue
+  (:mod:`.coordinator`);
+* **config rides the lease** — a lease carries the exact
+  ``search_by_chunks`` keyword subset (:data:`SEARCH_KEYS`) the
+  coordinator planned the file with, so workers need zero out-of-band
+  configuration and cannot drift onto a different ledger fingerprint;
+* the protocol assumes a **shared filesystem** for ``output_dir``
+  (ledgers + candidates); the HTTP link carries only control traffic,
+  never sample data.
+
+Version negotiation is deliberately blunt: ``register`` returns
+:data:`PROTOCOL_VERSION` and the worker refuses a mismatch — the PR 5
+snapshot-schema rule, applied to the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+__all__ = ["PROTOCOL_VERSION", "SEARCH_KEYS", "clean_search_config",
+           "get_json", "post_json", "require"]
+
+PROTOCOL_VERSION = 1
+
+#: the ``search_by_chunks`` keyword arguments a lease may carry.  The
+#: science-affecting subset feeds the ledger fingerprint via
+#: ``plan_survey`` — the coordinator and every worker MUST agree on
+#: these, which is why they travel in the lease rather than in worker
+#: configuration.  Session-shaping knobs (``output_dir``, ``resume``,
+#: ``chunks``, ``make_plots``, ``progress``, callbacks) are owned by
+#: the coordinator/worker themselves and deliberately excluded.
+SEARCH_KEYS = (
+    "dmmin", "dmmax", "chunk_length", "new_sample_time", "tmin",
+    "snr_threshold", "backend", "kernel", "exact_floor", "fft_zap",
+    "cut_outliers", "zero_dm", "period_search", "period_sigma_threshold",
+    "quarantine_policy", "overlap_persist", "dispatch_timeout",
+    "dispatch_retries", "dispatch_backoff", "persist_retries",
+    "persist_backoff",
+)
+
+
+def clean_search_config(config):
+    """Validate a lease search config; returns a plain JSON-safe dict.
+
+    Raises ``ValueError`` naming any key outside :data:`SEARCH_KEYS` —
+    a typoed knob must fail at submission, not silently fork the fleet
+    onto a different ledger fingerprint than the coordinator planned.
+    """
+    if not isinstance(config, dict):
+        raise ValueError("search config must be a JSON object")
+    unknown = sorted(set(config) - set(SEARCH_KEYS))
+    if unknown:
+        raise ValueError(
+            f"search config keys {unknown} are not leaseable "
+            f"(allowed: {sorted(SEARCH_KEYS)})")
+    out = {k: config[k] for k in SEARCH_KEYS if k in config}
+    # round-trip through JSON now: a non-serialisable value (a Mesh, a
+    # callable) must fail at add_survey time, not mid-lease on the wire
+    return json.loads(json.dumps(out))
+
+
+def require(doc, key, types, what="message"):
+    """Fetch ``doc[key]`` asserting its type; ``ValueError`` otherwise
+    (the HTTP layer maps that to a 400)."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"{what} must be a JSON object")
+    if key not in doc:
+        raise ValueError(f"{what} missing key {key!r}")
+    if not isinstance(doc[key], types):
+        raise ValueError(
+            f"{what} key {key!r} must be "
+            f"{getattr(types, '__name__', types)}, got "
+            f"{type(doc[key]).__name__}")
+    return doc[key]
+
+
+def post_json(url, doc, timeout=10.0):
+    """POST ``doc`` as JSON; returns the decoded response body.
+
+    Transport failures raise ``OSError`` (``urllib.error.URLError`` is
+    one); an HTTP error status raises ``ValueError`` carrying the
+    server's body — the coordinator puts the protocol violation text
+    there, so the worker's log names the actual problem.
+    """
+    req = urllib.request.Request(
+        url, method="POST", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode(errors="replace")
+        raise ValueError(f"{url} -> HTTP {exc.code}: {body.strip()}") \
+            from exc
+
+
+def get_json(url, timeout=5.0):
+    """GET a JSON document (the coordinator's worker-health probe).
+
+    Returns ``(status, doc)`` — a ``/healthz`` 503 is a *successful*
+    probe of a CRITICAL worker, so HTTP error statuses with a JSON body
+    are decoded, not raised.  Transport failures raise ``OSError``.
+    """
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode(errors="replace")
+        try:
+            return exc.code, json.loads(body or "{}")
+        except ValueError:
+            return exc.code, {"error": body.strip()}

@@ -1,0 +1,320 @@
+"""The fleet worker agent: lease, search, report, drain.
+
+:class:`FleetWorker` is a thin shell around the existing hardened
+driver — each leased unit runs through
+:func:`~pulsarutils_tpu.pipeline.search_pipeline.search_by_chunks` with
+``chunks=`` restricted to the lease and ``resume=True``, so every
+contract the single-process loop earned (exact-resume ledger,
+quarantine, dead-letters, canary-free byte identity) holds per unit by
+construction.  Around that it adds the fleet behaviours:
+
+* **register -> lease -> search -> complete** against a coordinator URL
+  (:mod:`.protocol`); each completion carries the worker's metrics
+  registry snapshot and health verdict, which the coordinator re-serves
+  at ``/fleet/metrics`` and ``/fleet/workers``;
+* **its own live surface** — the worker starts a
+  :class:`~pulsarutils_tpu.obs.server.ObsServer` whose ``/healthz`` the
+  coordinator probes for lease gating and work-stealing; the same
+  :class:`~pulsarutils_tpu.obs.health.HealthEngine` is fed per chunk by
+  the driver;
+* **graceful drain** (SIGTERM/SIGINT via
+  :meth:`install_signal_handlers`, or :meth:`drain` from code): the
+  in-flight chunk finishes, its persist + ledger write drains (the
+  driver's normal exit path), unstarted leases go back via ``release``,
+  and ``putpu_fleet_drains_total`` counts the event — preemptible-fleet
+  behaviour where an evicted VM loses *zero* completed work and leaves
+  zero torn chunks.
+
+A SIGKILLed worker (no drain) is the chaos case: its lease expires, the
+coordinator requeues whatever the ledger does not show done, and the
+re-search is idempotent — proven byte-identical in
+``tests/test_fleet.py`` and the chaos drill's fleet classes.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from ..faults import inject as fault_inject
+from ..obs import metrics as _metrics
+from ..obs.health import HealthEngine
+from ..obs.server import start_obs_server
+from ..utils.logging_utils import logger
+from . import protocol
+
+__all__ = ["FleetWorker"]
+
+
+class FleetWorker:
+    """One worker process/thread in a coordinator's fleet.
+
+    ``coordinator_url`` is the base of the coordinator's obs surface
+    (``http://host:port``); ``http_port`` binds the worker's OWN live
+    surface (``0`` = ephemeral — the coordinator learns the bound port
+    from the registered ``healthz_url``; ``None`` disables the surface
+    and with it health-probed stealing for this worker).  ``max_units``
+    is the lease batch size; ``health`` accepts a caller-owned engine
+    (tests force verdicts through it).  ``search_overrides`` merge over
+    the lease's search config — reserved for host-local, non-science
+    knobs (e.g. ``dispatch_timeout``); science keys arrive via the
+    lease and overriding them would fork the ledger fingerprint, so
+    don't.
+    """
+
+    def __init__(self, coordinator_url, *, worker_id=None, http_port=0,
+                 http_host="127.0.0.1", max_units=1, poll_s=None,
+                 health=None, search_overrides=None):
+        self.coordinator_url = coordinator_url.rstrip("/")
+        self.requested_id = worker_id
+        self.worker_id = None           # assigned at register
+        self.http_port = http_port
+        self.http_host = http_host
+        self.max_units = int(max_units)
+        self.poll_s = poll_s
+        self.engine = health if health is not None else HealthEngine()
+        self.search_overrides = dict(search_overrides or {})
+        self.units_done = 0
+        self.drained = False
+        self._drain = threading.Event()
+        self._server = None
+        self._lease_ttl_s = None
+
+    # -- drain ----------------------------------------------------------------
+
+    def drain(self):
+        """Request a graceful drain: the in-flight chunk finishes, the
+        ledger flushes, unstarted leases return to the coordinator."""
+        self._drain.set()
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT -> :meth:`drain` (main thread only — the CLI
+        entry calls this; in-process test workers call ``drain()``)."""
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda _sig, _frm: self.drain())
+
+    # -- protocol client ------------------------------------------------------
+
+    def _post(self, path, doc, timeout=30.0):
+        return protocol.post_json(self.coordinator_url + path, doc,
+                                  timeout=timeout)
+
+    def _register(self, retries=40, backoff_s=0.25):
+        healthz_url = None
+        if self.http_port is not None:
+            if self._server is None:   # re-registration keeps the port
+                self._server = start_obs_server(
+                    self.http_port, health=self.engine,
+                    progress_fn=self._progress_snapshot,
+                    host=self.http_host)
+            healthz_url = (f"http://{self.http_host}:"
+                           f"{self._server.port}/healthz")
+        last = None
+        for attempt in range(retries):
+            try:
+                doc = self._post("/fleet/register",
+                                 {"healthz_url": healthz_url,
+                                  "worker": self.requested_id})
+                break
+            except OSError as exc:     # coordinator not up yet
+                last = exc
+                time.sleep(backoff_s)
+        else:
+            raise OSError(
+                f"coordinator {self.coordinator_url} unreachable after "
+                f"{retries} attempts") from last
+        if doc.get("protocol_version") != protocol.PROTOCOL_VERSION:
+            raise ValueError(
+                f"coordinator speaks fleet protocol "
+                f"{doc.get('protocol_version')!r}, this worker speaks "
+                f"{protocol.PROTOCOL_VERSION} — upgrade one of them")
+        self.worker_id = doc["worker"]
+        self._lease_ttl_s = float(doc.get("lease_ttl_s") or 30.0)
+        if self.poll_s is None:
+            self.poll_s = float(doc.get("poll_s") or 0.25)
+        logger.info("fleet worker %s registered with %s (healthz: %s)",
+                    self.worker_id, self.coordinator_url,
+                    healthz_url or "disabled")
+
+    def _progress_snapshot(self):
+        return {"worker": self.worker_id, "units_done": self.units_done,
+                "draining": self._drain.is_set()}
+
+    # -- unit execution -------------------------------------------------------
+
+    def _run_unit(self, lease):
+        """Run one leased unit through the hardened driver; returns the
+        ``error`` string for the completion message (``None`` = clean).
+
+        jax runtime failures share no base class and one poisoned unit
+        must not kill the worker (the coordinator requeues it, bounded
+        by ``max_attempts``) — hence the broad handler, a reviewed
+        containment seam.  Deterministic configuration errors still
+        surface to the coordinator as the unit's error string, where
+        ``max_attempts`` stops the retry loop a crashing config would
+        otherwise spin.
+        """
+        from ..pipeline.search_pipeline import search_by_chunks
+
+        config = dict(lease["config"])
+        config.update(self.search_overrides)
+        # deterministic wedge/crash seam for the chaos drill: an armed
+        # FaultPlan (PUTPU_FAULT_PLAN survives the subprocess boundary)
+        # can hang or fail this worker at unit granularity
+        fault_inject.fire("fleet", chunk=lease["chunks"][0])
+        try:
+            search_by_chunks(
+                lease["fname"], chunks=lease["chunks"],
+                output_dir=lease["output_dir"], resume=True,
+                make_plots=False, progress=False, health=self.engine,
+                cancel_cb=self._drain.is_set, **config)
+            return None
+        except Exception as exc:
+            logger.error("fleet worker %s: unit %s failed (%r)",
+                         self.worker_id, lease["unit"], exc)
+            return repr(exc)
+
+    def _complete(self, lease, error):
+        return self._post("/fleet/complete", {
+            "worker": self.worker_id, "lease": lease["lease"],
+            "unit": lease["unit"], "error": error,
+            # a drain-truncated unit says so: the coordinator requeues
+            # the remainder WITHOUT burning the unit's max_attempts
+            # budget (cooperative preemption is not a poison chunk)
+            "drained": self._drain.is_set(),
+            "metrics": _metrics.REGISTRY.snapshot(),
+            "health": {"status": self.engine.verdict,
+                       "reasons": self.engine.reasons()}})
+
+    def _release(self, leases, reason):
+        if not leases:
+            return
+        try:
+            self._post("/fleet/release", {
+                "worker": self.worker_id,
+                "leases": [le["lease"] for le in leases],
+                "reason": reason})
+        except (OSError, ValueError) as exc:
+            # the coordinator is gone or rejecting: its lease TTL will
+            # requeue these anyway — drain must not hang on it
+            logger.warning("fleet worker %s: release failed (%r); the "
+                           "lease TTL covers it", self.worker_id, exc)
+
+    # -- the main loop --------------------------------------------------------
+
+    def run(self, max_idle_s=None):
+        """Register, then lease/search/complete until the survey is
+        done or a drain lands.  ``max_idle_s`` bounds how long the
+        worker polls an idle (but unfinished) queue before exiting —
+        ``None`` polls forever (the deployment shape: workers outlive
+        surveys).  Returns the number of units this worker completed.
+        """
+        self._register()
+        idle_since = None
+        try:
+            while not self._drain.is_set():
+                try:
+                    # the health self-report rides every lease request:
+                    # a denied worker whose transient conditions decayed
+                    # must be able to TELL the coordinator so (probes
+                    # only exist where a healthz_url was registered)
+                    resp = self._post("/fleet/lease",
+                                      {"worker": self.worker_id,
+                                       "max_units": self.max_units,
+                                       "health": {
+                                           "status": self.engine.verdict,
+                                           "reasons":
+                                               self.engine.reasons()}})
+                except (OSError, ValueError) as exc:
+                    if "unknown worker" in str(exc):
+                        # the coordinator restarted and lost its worker
+                        # table: re-register (same live surface/port)
+                        # instead of spinning as a zombie forever
+                        logger.warning(
+                            "fleet worker %s: coordinator no longer "
+                            "knows us (%r) — re-registering",
+                            self.worker_id, exc)
+                        self._register()
+                        continue
+                    logger.warning(
+                        "fleet worker %s: lease request failed (%r); "
+                        "retrying", self.worker_id, exc)
+                    # an unreachable coordinator counts as idle time:
+                    # run(max_idle_s=...) must still bound the wait
+                    if idle_since is None:
+                        idle_since = time.monotonic()
+                    elif max_idle_s is not None \
+                            and time.monotonic() - idle_since > max_idle_s:
+                        logger.info(
+                            "fleet worker %s: coordinator unreachable "
+                            "past %.1fs, exiting", self.worker_id,
+                            max_idle_s)
+                        break
+                    if self._drain.wait(self.poll_s):
+                        break
+                    continue
+                leases = resp.get("leases") or []
+                if not leases:
+                    if resp.get("survey_done"):
+                        logger.info("fleet worker %s: survey complete",
+                                    self.worker_id)
+                        break
+                    if resp.get("denied"):
+                        logger.info(
+                            "fleet worker %s: leases denied (%s) — "
+                            "standing by", self.worker_id,
+                            resp["denied"])
+                        # idle tick: a *data*-driven transient condition
+                        # (a pulse chunk's candidate spike) raised while
+                        # searching must be able to decay while denied,
+                        # or denial would be permanent — a neutral
+                        # update ages non-sticky conditions exactly as
+                        # clean chunks would (sticky ones, e.g. the
+                        # numpy fallback, rightly never recover)
+                        self.engine.update("fleet-idle")
+                    if idle_since is None:
+                        idle_since = time.monotonic()
+                    elif max_idle_s is not None \
+                            and time.monotonic() - idle_since \
+                            > max_idle_s:
+                        logger.info("fleet worker %s: idle past %.1fs, "
+                                    "exiting", self.worker_id, max_idle_s)
+                        break
+                    if self._drain.wait(self.poll_s):
+                        break
+                    continue
+                idle_since = None
+                for i, lease in enumerate(leases):
+                    if self._drain.is_set():
+                        # unstarted leases go straight back; the
+                        # coordinator re-leases them to live workers
+                        self._release(leases[i:], "drain")
+                        break
+                    error = self._run_unit(lease)
+                    try:
+                        self._complete(lease, error)
+                    except (OSError, ValueError) as exc:
+                        logger.warning(
+                            "fleet worker %s: completion report for %s "
+                            "failed (%r) — the ledger already records "
+                            "the work; the lease TTL resolves it",
+                            self.worker_id, lease["unit"], exc)
+                    if error is None:
+                        self.units_done += 1
+        finally:
+            if self._drain.is_set():
+                # the driver already flushed persists + ledger for the
+                # in-flight chunk (its normal exit path); this counts
+                # the drain and says so
+                self.drained = True
+                _metrics.counter("putpu_fleet_drains_total").inc()
+                logger.info(
+                    "fleet worker %s: drained (%d unit(s) completed; "
+                    "in-flight chunk finished, ledger flushed, "
+                    "unstarted leases returned)",
+                    self.worker_id or "<unregistered>", self.units_done)
+            if self._server is not None:
+                self._server.close()
+        return self.units_done

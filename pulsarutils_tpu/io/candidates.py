@@ -52,6 +52,10 @@ class CandidateStore:
             self._ledger_path = os.path.join(
                 self.directory, f"progress_{fingerprint}.json")
             self._ledger = self._load_ledger()
+        #: (st_size, st_mtime_ns) of OUR last ledger write — lets
+        #: mark_done skip the concurrent-session merge (one stat
+        #: instead of a read+parse) when nobody else has written
+        self._last_write_stat = None
 
     def _load_ledger(self):
         """Load the ledger, surviving a torn/corrupt file.
@@ -102,7 +106,26 @@ class CandidateStore:
         never re-searched on resume (exact resume semantics), and the
         reason survives in the ledger for the integrity audit.  The
         ``quarantined`` key only appears when a reason was recorded, so
-        a clean run's ledger stays byte-identical to pre-hardening."""
+        a clean run's ledger stays byte-identical to pre-hardening.
+
+        Fleet sessions (ISSUE 9) made the on-disk bytes *canonical*:
+
+        * the ``done`` list is kept **sorted** — a single-process run
+          already completes chunks in ascending order, so its ledger
+          bytes are unchanged, while N workers completing interleaved
+          subsets of one file converge on the identical file (the
+          byte-identity contract bench config 14 gates);
+        * each write **merges with the on-disk ledger** first.  Two
+          sessions share a ledger only in the work-stealing edge — a
+          stalled worker's lease expires, its remaining chunks are
+          re-leased, and the straggler still finishes its in-flight
+          chunk — and a blind rewrite from the straggler's stale
+          in-memory copy would erase the thief's entries.  The merge is
+          a union (chunks are only ever *added*), so last-writer-wins
+          degrades to no-loss; the coordinator additionally re-reads
+          the ledger at every grant/complete, so even a torn interleave
+          only causes an idempotent re-search, never a lost chunk.
+        """
         if self.fingerprint is None:
             return
         quarantined = self._ledger.get("quarantined", {})
@@ -114,10 +137,64 @@ class CandidateStore:
             if reason is not None:
                 self._ledger.setdefault(
                     "quarantined", {})[str(istart)] = str(reason)
+            self._merge_from_disk()
+            self._ledger["done"].sort()
+            if "quarantined" in self._ledger:
+                q = self._ledger["quarantined"]
+                # tolerant order: a wrong-shaped-but-parseable ledger
+                # (non-numeric key) must stay the carried-through
+                # oddity it always was, not a crash of every write
+                self._ledger["quarantined"] = {
+                    k: q[k] for k in sorted(
+                        q, key=lambda k: (0, int(k), "") if
+                        str(k).lstrip("-").isdigit() else (1, 0, str(k)))}
             tmp = self._ledger_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(self._ledger, f)
             os.replace(tmp, self._ledger_path)  # atomic: crash-safe resume
+            try:
+                st = os.stat(self._ledger_path)
+                self._last_write_stat = (st.st_size, st.st_mtime_ns)
+            except OSError:
+                self._last_write_stat = None
+
+    def _merge_from_disk(self):
+        """Union the in-memory ledger with the current on-disk one.
+
+        Unreadable/torn disk state is simply not merged (the in-memory
+        copy wins): this is a best-effort anti-lost-update measure for
+        concurrent fleet sessions, NOT the corruption-recovery path —
+        that stays in :meth:`_load_ledger`, which backs the bad file up.
+
+        Cost control: when the file's ``(size, mtime_ns)`` still match
+        OUR last write, nobody else has written and the read+parse is
+        skipped — a plain single-process survey pays one ``stat`` per
+        chunk instead of re-parsing an O(n) ledger n times.  A stale
+        match can only *skip* a merge, and the fleet coordinator
+        re-reads the ledger at every grant/complete anyway, so the
+        worst case stays an idempotent re-search, never a lost chunk.
+        """
+        try:
+            if self._last_write_stat is not None:
+                st = os.stat(self._ledger_path)
+                if (st.st_size, st.st_mtime_ns) == self._last_write_stat:
+                    return
+            with open(self._ledger_path) as f:
+                disk = json.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(disk, dict):
+            return
+        done = disk.get("done")
+        if isinstance(done, list):
+            have = set(self._ledger["done"])
+            self._ledger["done"].extend(
+                c for c in done if isinstance(c, int) and c not in have)
+        quarantined = disk.get("quarantined")
+        if isinstance(quarantined, dict):
+            mine = self._ledger.setdefault("quarantined", {})
+            for key, val in quarantined.items():
+                mine.setdefault(key, val)
 
     @property
     def done_chunks(self):

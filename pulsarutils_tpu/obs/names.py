@@ -108,6 +108,31 @@ METRIC_NAMES = {
         "chunk searches re-attempted after failure/timeout",
     "putpu_faults_injected_total":
         "fault-plan firings (labelled by site)",
+    "putpu_fleet_drains_total":
+        "graceful worker drains (in-flight chunk finished, ledger "
+        "flushed, unstarted leases returned)",
+    "putpu_fleet_duplicate_completions_total":
+        "unit completions whose lease was already expired/revoked "
+        "(the straggler side of a steal; resolved by the ledger)",
+    "putpu_fleet_leases_denied_total":
+        "lease requests denied to DEGRADED/CRITICAL workers",
+    "putpu_fleet_leases_expired_total":
+        "leases past their TTL, revoked and ledger-requeued",
+    "putpu_fleet_leases_granted_total":
+        "work-unit leases granted to workers",
+    "putpu_fleet_leases_revoked_total":
+        "leases revoked from CRITICAL/dead workers (work-stealing)",
+    "putpu_fleet_units_completed_total":
+        "work units the per-file ledger confirms fully done",
+    "putpu_fleet_units_failed_total":
+        "work units abandoned after max_attempts requeues",
+    "putpu_fleet_units_pending":
+        "work units currently waiting in the coordinator queue",
+    "putpu_fleet_units_requeued_total":
+        "work units put back in the queue (expiry, revoke, release, "
+        "error, or a completion the ledger did not back)",
+    "putpu_fleet_workers":
+        "workers currently registered and alive",
     "putpu_health_incidents_total":
         "health conditions raised (labelled by kind)",
     "putpu_health_status":

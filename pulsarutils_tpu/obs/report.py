@@ -45,7 +45,7 @@ def _fmt(v, nd=3):
 
 def build_report(*, meta=None, budget=None, roofline=None, health=None,
                  canary=None, quarantine=None, sift=None, metrics=None,
-                 coincidence=None):
+                 coincidence=None, fleet=None):
     """Assemble the structured report record (JSON-ready).
 
     ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
@@ -56,7 +56,8 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
     dict; ``metrics``: a registry snapshot list (key totals are pulled
     out for the header); ``coincidence``: ``{"stats": COINCIDENCE_JSON
     dict, "groups": beams.coincidence.group_summary(...) rows}`` from
-    the multi-beam driver.
+    the multi-beam driver; ``fleet``:
+    ``FleetCoordinator.summary()`` from a coordinator run (ISSUE 9).
     """
     rec = {
         "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -68,6 +69,7 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
         "quarantine": quarantine or [],
         "sift": sift,
         "coincidence": coincidence,
+        "fleet": fleet,
     }
     if metrics:
         totals = {}
@@ -266,6 +268,27 @@ def render_markdown(rec):
     else:
         lines.append("No coincidence telemetry (single-beam run or the "
                      "cross-beam sift was skipped).")
+    lines.append("")
+
+    lines.append("## Fleet")
+    lines.append("")
+    fleet = rec.get("fleet")
+    if fleet:
+        lines.append(
+            f"{fleet.get('chunks_done', 0)}/{fleet.get('chunks_total', 0)} "
+            "chunks completed across the fleet "
+            f"(survey_done: {fleet.get('survey_done')}); units: `"
+            + json.dumps(fleet.get("units", {})) + "`; lease stats: `"
+            + json.dumps(fleet.get("stats", {})) + "`")
+        lines.append("")
+        if fleet.get("workers"):
+            lines.append(_md_table(
+                ("worker", "verdict", "alive", "units completed"),
+                [(w["worker"], w["verdict"], w["alive"],
+                  w["units_completed"]) for w in fleet["workers"]]))
+    else:
+        lines.append("Single-process run: no fleet coordinator was "
+                     "involved.")
     lines.append("")
 
     lines.append("## Quarantine manifest")

@@ -11,8 +11,12 @@ takes — and, when a :class:`~pulsarutils_tpu.beams.service.
 SurveyService` is wired in (ISSUE 8), the job-submission API:
 ``POST /jobs`` (submit, 201 + job id; 400 on a bad spec),
 ``GET /jobs`` / ``GET /jobs/<id>`` (status documents incl. per-job
-health + coincidence), ``POST /jobs/<id>/cancel``.  Read-only
-endpoints:
+health + coincidence), ``POST /jobs/<id>/cancel``.  With a
+:class:`~pulsarutils_tpu.fleet.coordinator.FleetCoordinator` wired in
+(ISSUE 9) the same server is the fleet coordinator surface: the wire
+protocol (``POST /fleet/{register,lease,complete,release}``) and the
+read endpoints (``GET /fleet/{workers,leases,progress}`` and the
+fleet-aggregated ``GET /fleet/metrics``).  Read-only endpoints:
 
 * ``/metrics`` — the live Prometheus text exposition of the process
   registry (complementing, not replacing, the textfile route);
@@ -77,9 +81,11 @@ class _Handler(BaseHTTPRequestHandler):
                                            indent=1), "application/json")
             elif path == "/jobs" or path.startswith("/jobs/"):
                 self._get_jobs(srv, path)
+            elif path.startswith("/fleet"):
+                self._get_fleet(srv, path)
             elif path == "/":
                 self._send(200, "pulsarutils_tpu live survey surface: "
-                           "/metrics /healthz /progress /jobs\n",
+                           "/metrics /healthz /progress /jobs /fleet\n",
                            "text/plain")
             else:
                 self._send(404, "not found\n", "text/plain")
@@ -105,23 +111,79 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(200, json.dumps(doc, indent=1), "application/json")
 
+    def _get_fleet(self, srv, path):
+        """GET /fleet/{workers,leases,progress,metrics}: the
+        coordinator's read surface (ISSUE 9).  ``/fleet/metrics`` is
+        the fleet-AGGREGATED Prometheus page — every worker's last
+        reported registry snapshot with a ``worker`` label — while the
+        coordinator process's own registry stays on plain
+        ``/metrics``."""
+        if srv.fleet is None:
+            self._send(404, "no fleet coordinator wired (start the "
+                       "server with fleet=FleetCoordinator(...))\n",
+                       "text/plain")
+            return
+        if path == "/fleet/metrics":
+            self._send(200, srv.fleet.fleet_metrics_text(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return
+        docs = {"/fleet/workers": srv.fleet.workers_doc,
+                "/fleet/leases": srv.fleet.leases_doc,
+                "/fleet/progress": srv.fleet.progress_doc}
+        fn = docs.get(path)
+        if fn is None:
+            self._send(404, "not found\n", "text/plain")
+        else:
+            self._send(200, json.dumps(fn(), indent=1),
+                       "application/json")
+
+    def _read_body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n).decode() or "{}")
+
+    def _post_fleet(self, srv, path):
+        """POST /fleet/{register,lease,complete,release}: the fleet
+        wire protocol (:mod:`pulsarutils_tpu.fleet.protocol`).
+        Protocol violations (``ValueError``) map to 400 with the
+        message in the body, so the worker's log names the problem."""
+        if srv.fleet is None:
+            self._send(404, "no fleet coordinator wired\n", "text/plain")
+            return
+        handlers = {"/fleet/register": srv.fleet.register,
+                    "/fleet/lease": srv.fleet.lease,
+                    "/fleet/complete": srv.fleet.complete,
+                    "/fleet/release": srv.fleet.release}
+        fn = handlers.get(path)
+        if fn is None:
+            self._send(404, "not found\n", "text/plain")
+            return
+        try:
+            doc = fn(self._read_body())
+        except ValueError as exc:
+            self._send(400, json.dumps({"error": str(exc)}),
+                       "application/json")
+            return
+        self._send(200, json.dumps(doc), "application/json")
+
     def do_POST(self):  # noqa: N802 — http.server API
         """The job-submission API (ISSUE 8): ``POST /jobs`` with a JSON
         body ``{"fname": ..., "dmmin": ..., "dmmax": ..., ...}``
         submits (201 + ``{"job_id": ...}``), ``POST /jobs/<id>/cancel``
-        requests cancellation.  A request must never kill the service —
+        requests cancellation — plus the fleet wire protocol under
+        ``/fleet/`` (ISSUE 9).  A request must never kill the service —
         same containment rule as the GET scrape handler."""
         srv = self.server.obs  # type: ignore[attr-defined]
         try:
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if path.startswith("/fleet"):
+                self._post_fleet(srv, path)
+                return
             if srv.service is None:
                 self._send(404, "no job service wired\n", "text/plain")
                 return
             if path == "/jobs":
                 try:
-                    n = int(self.headers.get("Content-Length") or 0)
-                    spec = json.loads(self.rfile.read(n).decode() or "{}")
-                    job_id = srv.service.submit(spec)
+                    job_id = srv.service.submit(self._read_body())
                 except ValueError as exc:
                     self._send(400, json.dumps({"error": str(exc)}),
                                "application/json")
@@ -156,13 +218,20 @@ class ObsServer:
     """
 
     def __init__(self, port=0, health=None, progress_fn=None,
-                 registry=None, host="127.0.0.1", service=None):
+                 registry=None, host="127.0.0.1", service=None,
+                 fleet=None):
         self.health = health
         self.progress_fn = progress_fn
         #: a :class:`~pulsarutils_tpu.beams.service.SurveyService` (or
         #: None): wired, the surface grows the job-submission API —
         #: POST /jobs, GET /jobs[/<id>], POST /jobs/<id>/cancel
         self.service = service
+        #: a :class:`~pulsarutils_tpu.fleet.coordinator.
+        #: FleetCoordinator` (or None): wired, the surface grows the
+        #: fleet protocol (POST /fleet/{register,lease,complete,
+        #: release}) and read endpoints (GET /fleet/{workers,leases,
+        #: progress,metrics})
+        self.fleet = fleet
         self.registry = registry if registry is not None \
             else _metrics.REGISTRY
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
@@ -209,7 +278,7 @@ class ObsServer:
 
 
 def start_obs_server(port, health=None, progress_fn=None, registry=None,
-                     host="127.0.0.1", service=None):
+                     host="127.0.0.1", service=None, fleet=None):
     """Start the live surface; returns the :class:`ObsServer` handle
     (``handle.port`` holds the bound port — pass ``port=0`` for an
     ephemeral one).  ``host`` is the bind address: the loopback default
@@ -217,6 +286,11 @@ def start_obs_server(port, health=None, progress_fn=None, registry=None,
     specific interface) so a remote Prometheus scrape job or a fleet
     scheduler's ``/healthz`` probe can reach it.  ``service`` (a
     :class:`~pulsarutils_tpu.beams.service.SurveyService`) additionally
-    serves the multi-tenant job API under ``/jobs``."""
+    serves the multi-tenant job API under ``/jobs``; ``fleet`` (a
+    :class:`~pulsarutils_tpu.fleet.coordinator.FleetCoordinator`)
+    serves the fleet wire protocol + read endpoints under ``/fleet/``
+    — the coordinator role is this same ThreadingHTTPServer machinery,
+    not a second stack."""
     return ObsServer(port=port, health=health, progress_fn=progress_fn,
-                     registry=registry, host=host, service=service)
+                     registry=registry, host=host, service=service,
+                     fleet=fleet)

@@ -192,6 +192,151 @@ class _ReadFailure:
         self.exc = exc
 
 
+def plan_survey(fname, chunk_length=None, new_sample_time=None, tmin=0,
+                dmmin=200, dmmax=800, surelybad=(), *, backend="jax",
+                kernel="auto", snr_threshold=6.0, fft_zap=False,
+                cut_outliers=False, zero_dm=False, mesh=None,
+                exact_floor="auto", quarantine_policy="sanitize",
+                period_search=False, period_sigma_threshold=8.0):
+    """Resolve a survey's geometry, threshold and resume fingerprint
+    WITHOUT searching anything.
+
+    This is the single source of truth :func:`search_by_chunks` plans
+    from, split out (ISSUE 9) so the fleet coordinator
+    (:mod:`..fleet.coordinator`) can shard a file into the *exact* chunk
+    grid — and read the *exact* resume-ledger fingerprint — that a
+    worker's ``search_by_chunks`` run will use.  Any drift between the
+    two would silently orphan ledgers across the fleet, so there is
+    deliberately no second copy of this logic anywhere.
+
+    Returns a dict: ``reader`` (the open
+    :class:`~pulsarutils_tpu.io.sigproc.FilterbankReader`), ``plan``
+    (the :class:`~pulsarutils_tpu.parallel.stream.ChunkPlan`),
+    ``chunk_starts`` (every planned chunk ``istart``, before any resume
+    filtering), ``snr_threshold`` (the resolved float — ``"auto"`` /
+    ``"certifiable"`` strings are resolved here), ``search_snr_floor``
+    (the hybrid's forwarded floor, or ``None``), ``fingerprint`` (the
+    resume-ledger key), ``root`` (the candidate filename stem) and
+    ``nsamples``/``sample_time``.
+    """
+    logger.info("opening %s", fname)
+    # strip only the final extension: "obs.day1.fil" and "obs.day2.fil"
+    # must keep distinct candidate roots in a shared output directory
+    root = os.path.splitext(os.path.basename(str(fname)))[0]
+    reader = FilterbankReader(fname)
+    header = reader.header
+    nsamples = header["nsamples"]
+    sample_time = header["tsamp"]
+    start_freq = header["fbottom"]
+    stop_freq = header["ftop"]
+    bandwidth = header["bandwidth"]
+    foff = header["foff"]
+
+    plan = plan_chunks(nsamples, sample_time, dmmin, dmmax, start_freq,
+                       stop_freq, foff, chunk_length=chunk_length,
+                       new_sample_time=new_sample_time)
+    eff_tsamp = plan.sample_time
+    logger.info("chunk plan: step=%d hop=%d resample=%d -> tsamp=%g s",
+                plan.step, plan.hop, plan.resample, eff_tsamp)
+
+    def _chunk_cert_floor():
+        """Certifiable floor for this chunk geometry (lazy: the
+        retention bound is a multi-second host computation at
+        multi-thousand-trial configs and only two configurations need
+        it — snr_threshold='certifiable', and the hybrid's
+        exact_floor='auto' comparison)."""
+        from ..ops.certify import certifiable_snr_floor, retention_bound
+        from ..ops.plan import dedispersion_plan
+
+        nchan = header["nchans"]
+        t_eff = max(plan.step // plan.resample, 2)
+        trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
+                                      bandwidth, eff_tsamp)
+        rho = retention_bound(nchan, trial_dms, start_freq, bandwidth,
+                              eff_tsamp, t_eff, cert=True)
+        return certifiable_snr_floor(t_eff, len(trial_dms), rho)
+
+    if isinstance(snr_threshold, str):
+        from ..ops.certify import matched_snr_floor
+        from ..ops.plan import dedispersion_plan
+
+        t_eff = max(plan.step // plan.resample, 2)
+        if snr_threshold == "auto":
+            ndm = len(dedispersion_plan(header["nchans"], dmmin, dmmax,
+                                        start_freq, bandwidth, eff_tsamp))
+            # clamped to the reference default (clean.py:349): at short
+            # chunks the matched floor resolves BELOW 6 and "auto" must
+            # never be more permissive than the reference's criterion
+            # (the Gumbel fit is also least validated at small m —
+            # certify.expected_noise_max_snr's stated fit domain)
+            snr_threshold = max(matched_snr_floor(t_eff, ndm), 6.0)
+        elif snr_threshold == "certifiable":
+            snr_threshold = _chunk_cert_floor()
+        else:
+            raise ValueError(
+                f"snr_threshold={snr_threshold!r}: expected a number, "
+                "'auto' or 'certifiable'")
+        snr_threshold = round(float(snr_threshold), 2)
+        logger.info("snr_threshold resolved to %.2f for %d-sample chunks",
+                    snr_threshold, t_eff)
+
+    # the hybrid gets the threshold as its snr_floor ONLY when the noise
+    # certificate can actually fire at that level: forwarding a
+    # sub-certifiable floor (e.g. the reference default 6.0 on
+    # million-sample chunks) would make the rigorous all-detections-exact
+    # criterion rescan toward a full exact sweep on EVERY chunk — the
+    # round-2 behaviour this round removed.  Below the certifiable level
+    # the hybrid runs floorless (exact-argbest-only contract, the round-2
+    # streaming semantics), which is both faster and what the fixed
+    # thresholds historically meant.
+    search_snr_floor = None
+    if kernel == "hybrid" and exact_floor is not False:
+        cert_floor = None if exact_floor is True else _chunk_cert_floor()
+        if exact_floor is True \
+                or snr_threshold >= round(cert_floor, 2) - 1e-9:
+            search_snr_floor = snr_threshold
+        else:
+            logger.info(
+                "snr_threshold %.2f sits below the certifiable floor "
+                "%.2f for this chunk geometry: hybrid runs without "
+                "snr_floor (exact best row only; pass exact_floor=True "
+                "to force the all-detections-exact contract, or "
+                "snr_threshold='certifiable' for the noise-certificate "
+                "fast path)", snr_threshold, cert_floor)
+
+    fingerprint = config_fingerprint(
+        fname=os.path.abspath(str(fname)), dmmin=dmmin, dmmax=dmmax,
+        step=plan.step, resample=plan.resample, backend=backend,
+        kernel=kernel, snr_threshold=snr_threshold, fft_zap=fft_zap,
+        cut_outliers=cut_outliers,
+        # only fingerprint zero_dm when it changes the result: adding the
+        # key unconditionally would orphan every pre-existing resume
+        # ledger for plain runs
+        **({"zero_dm": True} if zero_dm else {}),
+        # same orphan-avoidance rule for the mesh route (device count
+        # changes the f32 reduction shapes, not the science)
+        **({"mesh": list(mesh.shape.values())} if mesh is not None else {}),
+        # and for the integrity gate: a non-default policy changes what
+        # gets searched on flagged data, so its ledger must not be
+        # interchangeable with the default's (a default-policy run
+        # keeps the pre-hardening fingerprint — no orphaned ledgers)
+        **({"quarantine_policy": str(quarantine_policy)}
+           if quarantine_policy != "sanitize" else {}),
+        surelybad=sorted(int(c) for c in surelybad),
+        period_search=bool(period_search),
+        period_sigma_threshold=float(period_sigma_threshold))
+
+    return {
+        "reader": reader, "plan": plan, "root": root,
+        "nsamples": nsamples, "sample_time": sample_time,
+        "snr_threshold": snr_threshold,
+        "search_snr_floor": search_snr_floor,
+        "fingerprint": fingerprint,
+        "chunk_starts": list(iter_chunk_starts(nsamples, plan, tmin=tmin,
+                                               sample_time=sample_time)),
+    }
+
+
 def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      dmmin=200, dmmax=800, surelybad=(), *, backend="jax",
                      kernel="auto", snr_threshold=6.0, output_dir=None,
@@ -204,7 +349,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      dispatch_backoff=0.0, quarantine_policy="sanitize",
                      persist_retries=2, persist_backoff=0.05,
                      http_port=None, http_host="127.0.0.1", canary=None,
-                     health=None, report_out=None):
+                     health=None, report_out=None, chunks=None,
+                     cancel_cb=None):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -360,6 +506,23 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
       budget, roofline, canary recall curve, health incidents, sift
       counters and the quarantine manifest into one artifact.
 
+    Fleet knobs (ISSUE 9; ``docs/fleet.md``) — default-off, byte-inert
+    when unset:
+
+    * ``chunks`` restricts the session to the given chunk ``istart``
+      values (an iterable; chunk starts not in the plan are ignored).
+      This is the fleet worker's lease seam: a leased work unit is a
+      subset of one file's chunk grid, and each chunk's persisted
+      candidate/ledger bytes are independent of which session searches
+      it — the byte-identity contract bench config 14 gates.  Chunks
+      outside the subset are neither searched nor marked done;
+    * ``cancel_cb`` (zero-arg callable) is checked before each chunk:
+      once it returns True the session finishes nothing further — the
+      in-flight chunk completes, its persist/ledger write drains, and
+      the remaining chunks stay un-marked so a resumed (or re-leased)
+      session picks up exactly there.  This is the worker's graceful
+      drain seam.
+
     Returns ``(hits, store)`` where hits is a list of
     ``(istart, iend, PulseInfo, ResultTable)``.  NOTE (round 6): when
     plotting is off, a hit's retained/persisted ``info.allprofs`` is the
@@ -399,10 +562,6 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         canary = CanaryController(rate=float(canary))
     if canary is not None and canary.rate <= 0.0:
         canary = None  # rate 0 is the documented spelled-out "off"
-    logger.info("opening %s", fname)
-    # strip only the final extension: "obs.day1.fil" and "obs.day2.fil"
-    # must keep distinct candidate roots in a shared output directory
-    root = os.path.splitext(os.path.basename(str(fname)))[0]
     output_dir = output_dir or os.path.dirname(os.path.abspath(str(fname)))
 
     if make_plots:
@@ -427,112 +586,37 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         with fault_inject.suppressed():
             mask_fileorder = get_bad_chans(fname, surelybad=surelybad)
 
-    reader = FilterbankReader(fname)
+    # geometry, resolved threshold and ledger fingerprint all come from
+    # the ONE planning function the fleet coordinator also calls — any
+    # second copy of this logic would let coordinator and worker drift
+    # onto different ledgers (ISSUE 9)
+    sp = plan_survey(fname, chunk_length=chunk_length,
+                     new_sample_time=new_sample_time, tmin=tmin,
+                     dmmin=dmmin, dmmax=dmmax, surelybad=surelybad,
+                     backend=backend, kernel=kernel,
+                     snr_threshold=snr_threshold, fft_zap=fft_zap,
+                     cut_outliers=cut_outliers, zero_dm=zero_dm,
+                     mesh=mesh, exact_floor=exact_floor,
+                     quarantine_policy=quarantine_policy,
+                     period_search=period_search,
+                     period_sigma_threshold=period_sigma_threshold)
+    reader = sp["reader"]
+    root = sp["root"]
     header = reader.header
-    nsamples = header["nsamples"]
-    sample_time = header["tsamp"]
+    nsamples = sp["nsamples"]
+    sample_time = sp["sample_time"]
     start_freq = header["fbottom"]
-    stop_freq = header["ftop"]
     bandwidth = header["bandwidth"]
-    foff = header["foff"]
     date = header.get("tstart", None)
 
     # single place that owns band orientation: ascending everywhere below
     mask = mask_fileorder[::-1] if reader.band_descending else mask_fileorder
 
-    plan = plan_chunks(nsamples, sample_time, dmmin, dmmax, start_freq,
-                       stop_freq, foff, chunk_length=chunk_length,
-                       new_sample_time=new_sample_time)
+    plan = sp["plan"]
     eff_tsamp = plan.sample_time
-    logger.info("chunk plan: step=%d hop=%d resample=%d -> tsamp=%g s",
-                plan.step, plan.hop, plan.resample, eff_tsamp)
-
-    def _chunk_cert_floor():
-        """Certifiable floor for this chunk geometry (lazy: the
-        retention bound is a multi-second host computation at
-        multi-thousand-trial configs and only two configurations need
-        it — snr_threshold='certifiable', and the hybrid's
-        exact_floor='auto' comparison)."""
-        from ..ops.certify import certifiable_snr_floor, retention_bound
-        from ..ops.plan import dedispersion_plan
-
-        nchan = header["nchans"]
-        t_eff = max(plan.step // plan.resample, 2)
-        trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
-                                      bandwidth, eff_tsamp)
-        rho = retention_bound(nchan, trial_dms, start_freq, bandwidth,
-                              eff_tsamp, t_eff, cert=True)
-        return certifiable_snr_floor(t_eff, len(trial_dms), rho)
-
-    if isinstance(snr_threshold, str):
-        from ..ops.certify import matched_snr_floor
-        from ..ops.plan import dedispersion_plan
-
-        t_eff = max(plan.step // plan.resample, 2)
-        if snr_threshold == "auto":
-            ndm = len(dedispersion_plan(header["nchans"], dmmin, dmmax,
-                                        start_freq, bandwidth, eff_tsamp))
-            # clamped to the reference default (clean.py:349): at short
-            # chunks the matched floor resolves BELOW 6 and "auto" must
-            # never be more permissive than the reference's criterion
-            # (the Gumbel fit is also least validated at small m —
-            # certify.expected_noise_max_snr's stated fit domain)
-            snr_threshold = max(matched_snr_floor(t_eff, ndm), 6.0)
-        elif snr_threshold == "certifiable":
-            snr_threshold = _chunk_cert_floor()
-        else:
-            raise ValueError(
-                f"snr_threshold={snr_threshold!r}: expected a number, "
-                "'auto' or 'certifiable'")
-        snr_threshold = round(float(snr_threshold), 2)
-        logger.info("snr_threshold resolved to %.2f for %d-sample chunks",
-                    snr_threshold, t_eff)
-
-    # the hybrid gets the threshold as its snr_floor ONLY when the noise
-    # certificate can actually fire at that level: forwarding a
-    # sub-certifiable floor (e.g. the reference default 6.0 on
-    # million-sample chunks) would make the rigorous all-detections-exact
-    # criterion rescan toward a full exact sweep on EVERY chunk — the
-    # round-2 behaviour this round removed.  Below the certifiable level
-    # the hybrid runs floorless (exact-argbest-only contract, the round-2
-    # streaming semantics), which is both faster and what the fixed
-    # thresholds historically meant.
-    search_snr_floor = None
-    if kernel == "hybrid" and exact_floor is not False:
-        cert_floor = None if exact_floor is True else _chunk_cert_floor()
-        if exact_floor is True \
-                or snr_threshold >= round(cert_floor, 2) - 1e-9:
-            search_snr_floor = snr_threshold
-        else:
-            logger.info(
-                "snr_threshold %.2f sits below the certifiable floor "
-                "%.2f for this chunk geometry: hybrid runs without "
-                "snr_floor (exact best row only; pass exact_floor=True "
-                "to force the all-detections-exact contract, or "
-                "snr_threshold='certifiable' for the noise-certificate "
-                "fast path)", snr_threshold, cert_floor)
-
-    fingerprint = config_fingerprint(
-        fname=os.path.abspath(str(fname)), dmmin=dmmin, dmmax=dmmax,
-        step=plan.step, resample=plan.resample, backend=backend,
-        kernel=kernel, snr_threshold=snr_threshold, fft_zap=fft_zap,
-        cut_outliers=cut_outliers,
-        # only fingerprint zero_dm when it changes the result: adding the
-        # key unconditionally would orphan every pre-existing resume
-        # ledger for plain runs
-        **({"zero_dm": True} if zero_dm else {}),
-        # same orphan-avoidance rule for the mesh route (device count
-        # changes the f32 reduction shapes, not the science)
-        **({"mesh": list(mesh.shape.values())} if mesh is not None else {}),
-        # and for the integrity gate: a non-default policy changes what
-        # gets searched on flagged data, so its ledger must not be
-        # interchangeable with the default's (a default-policy run
-        # keeps the pre-hardening fingerprint — no orphaned ledgers)
-        **({"quarantine_policy": str(quarantine_policy)}
-           if quarantine_policy != "sanitize" else {}),
-        surelybad=sorted(int(c) for c in surelybad),
-        period_search=bool(period_search),
-        period_sigma_threshold=float(period_sigma_threshold))
+    snr_threshold = sp["snr_threshold"]
+    search_snr_floor = sp["search_snr_floor"]
+    fingerprint = sp["fingerprint"]
     store = CandidateStore(output_dir, fingerprint if resume else None)
     # quarantine manifest: created lazily on first record, so a clean
     # run's output directory is byte-identical to pre-hardening
@@ -623,9 +707,15 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     # the chunk list is known upfront, so the NEXT chunk's read/decode
     # overlaps the current chunk's device compute (single reader thread —
     # the driver host is often one core doing nothing during the search)
-    todo = [s for s in iter_chunk_starts(nsamples, plan, tmin=tmin,
-                                         sample_time=sample_time)
+    todo = [s for s in sp["chunk_starts"]
             if not (resume and store.is_done(s))]
+    if chunks is not None:
+        # fleet lease subset: only the leased chunk starts are searched
+        # (or marked done) this session; unknown starts are ignored so a
+        # stale lease over a replanned file degrades to a no-op, not a
+        # crash
+        wanted = {int(c) for c in chunks}
+        todo = [s for s in todo if s in wanted]
     if max_chunks is not None:
         todo = todo[:max_chunks]
 
@@ -867,6 +957,14 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     array_dev = None  # chunk's prefetched device buffer (if any)
     try:
         for ichunk, istart in enumerate(todo):
+          if cancel_cb is not None and cancel_cb():
+              # graceful drain (fleet workers, service cancel): nothing
+              # further starts; completed chunks are already persisted +
+              # marked, the rest stay un-marked for the next session
+              logger.info("search cancelled before chunk %d: %d of %d "
+                          "chunks left for a resumed session", istart,
+                          len(todo) - ichunk, len(todo))
+              break
           with timer.chunk(istart):
             t_chunk = time.perf_counter()
             chunk_size = min(plan.step, nsamples - istart)

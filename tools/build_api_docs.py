@@ -48,6 +48,9 @@ MODULES = [
     "pulsarutils_tpu.beams.multibeam",
     "pulsarutils_tpu.beams.coincidence",
     "pulsarutils_tpu.beams.service",
+    "pulsarutils_tpu.fleet.protocol",
+    "pulsarutils_tpu.fleet.coordinator",
+    "pulsarutils_tpu.fleet.worker",
     "pulsarutils_tpu.io.sigproc",
     "pulsarutils_tpu.io.lowbit",
     "pulsarutils_tpu.io.candidates",

@@ -342,15 +342,184 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     return result
 
 
+# ---------------------------------------------------------------------------
+# fleet chaos classes (ISSUE 9): killed and wedged workers
+# ---------------------------------------------------------------------------
+
+#: how long the wedge fault hangs a worker at the fleet seam — must sit
+#: far past the drill's lease TTL so the steal (not the wedged worker
+#: waking up mid-drill) is what finishes the unit
+WEDGE_S = 300.0
+FLEET_LEASE_TTL_S = 6.0
+
+
+def _spawn_worker_proc(base_dir, url, worker_id, fault_plan=None):
+    """A real worker OS process (``python -m ...cli.fleet_main worker``)
+    — the only honest way to SIGKILL one.  ``fault_plan`` rides the
+    ``PUTPU_FAULT_PLAN`` env var across the process boundary (the PR 4
+    mechanism), so the drill can wedge a worker deterministically."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    if fault_plan is not None:
+        env["PUTPU_FAULT_PLAN"] = fault_plan.to_json()
+    log_path = os.path.join(base_dir, f"worker_{worker_id}.log")
+    logf = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pulsarutils_tpu.cli.fleet_main",
+         "worker", "--coordinator", url, "--worker-id", worker_id,
+         "--max-idle", "60"],
+        env=env, cwd=repo, stdout=logf, stderr=logf)
+    proc._drill_logf = logf  # closed by _reap
+    return proc
+
+
+def _reap(proc, kill=True):
+    if proc.poll() is None and kill:
+        proc.kill()
+    try:
+        proc.wait(timeout=30)
+    finally:
+        proc._drill_logf.close()
+
+
+def _wait_for(predicate, timeout_s, interval_s=0.2):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def _fleet_class(name, base_dir, path, baseline, fingerprint, log,
+                 kill_after_lease):
+    """One fleet chaos class over the drill survey file.
+
+    ``kill_after_lease=True`` is the **killed_worker** class: the
+    victim subprocess is SIGKILLed while it holds a lease (it is wedged
+    at the fleet seam pre-search, so nothing is marked); ``False`` is
+    **wedged_worker**: the victim stays alive but hung far past the
+    lease TTL, so the coordinator must steal from it.  Either way a
+    healthy in-process worker finishes the survey and the outputs must
+    be byte-identical to the single-process baseline.
+    """
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    outdir = os.path.join(base_dir, name)
+    t0 = time.time()
+    coordinator = FleetCoordinator(
+        outdir, lease_ttl_s=FLEET_LEASE_TTL_S, chunks_per_unit=1,
+        probe_interval_s=0.5, auto_sweep=True)
+    server = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{server.port}"
+    coordinator.add_survey([path], **{k: v for k, v in SEARCH_KW.items()
+                                      if k not in ("make_plots",
+                                                   "progress")})
+    # the victim wedges at the fleet seam before its first unit's
+    # search starts — deterministic "mid-lease" state for the kill
+    plan = FaultPlan([FaultSpec(site="fleet", kind="hang",
+                                seconds=WEDGE_S, times=1)])
+    victim = _spawn_worker_proc(base_dir, url, f"victim-{name}",
+                                fault_plan=plan)
+    rec = {"recoverable": True}
+    try:
+        leased = _wait_for(
+            lambda: coordinator.leases_doc()["leases"], timeout_s=120)
+        rec["victim_leased"] = leased
+        if kill_after_lease:
+            victim.kill()      # SIGKILL: no drain, no release, nothing
+            log(f"chaos drill: {name}: victim SIGKILLed holding "
+                f"{len(coordinator.leases_doc()['leases'])} lease(s)")
+        rescuer = FleetWorker(url, http_port=None)
+        rescuer.run(max_idle_s=90)
+        done = _wait_for(lambda: coordinator.survey_done, timeout_s=60)
+        rec["survey_done"] = done
+    finally:
+        _reap(victim)
+        server.close()
+        coordinator.close()
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    stats = coordinator.progress_doc()["stats"]
+    rec.update({
+        "byte_identical": not diffs, "diffs": diffs,
+        "stolen_leases": stats["expired"] + stats["revoked"],
+        "stats": stats, "wall_s": round(time.time() - t0, 2),
+        "ok": (rec.get("victim_leased", False) and rec["survey_done"]
+               and not diffs
+               and stats["expired"] + stats["revoked"] >= 1)})
+    return rec
+
+
+def run_fleet_drill(quick=False, log=print, workdir=None, keep=False):
+    """The fleet chaos classes (ISSUE 9): killed_worker (SIGKILL while
+    holding a lease) and wedged_worker (hung far past the lease TTL).
+    Both must complete the survey byte-identical to the single-process
+    baseline via lease expiry + ledger-checked requeue.  Slow (spawns
+    real worker processes); runs as a ``slow``+``chaos`` pytest and via
+    ``--fleet`` here — config 14 gates the fast in-process equivalent.
+    """
+    t_start = time.time()
+    base_dir = workdir or tempfile.mkdtemp(prefix="chaos_fleet_")
+    os.makedirs(base_dir, exist_ok=True)
+    path = os.path.join(base_dir, "survey.fil")
+    make_survey_file(path)
+    from pulsarutils_tpu.pipeline.spectral_stats import get_bad_chans
+
+    get_bad_chans(path)
+
+    log("fleet drill: single-process baseline run")
+    hits, store = run_search(path, os.path.join(base_dir, "baseline"))
+    assert hits, "baseline run found no candidates — drill is vacuous"
+    fingerprint = store.fingerprint
+    baseline = snapshot_outputs(os.path.join(base_dir, "baseline"),
+                                fingerprint)
+
+    classes = {}
+    for name, kill in (("killed_worker", True), ("wedged_worker", False)):
+        log(f"fleet drill: class {name}")
+        classes[name] = _fleet_class(name, base_dir, path, baseline,
+                                     fingerprint, log, kill)
+        log(f"fleet drill: class {name}: "
+            f"{'PASS' if classes[name]['ok'] else 'FAIL ' + str(classes[name])}")
+
+    result = {
+        "n_classes": len(classes),
+        "all_ok": all(r["ok"] for r in classes.values()),
+        "classes": classes,
+        "wall_s": round(time.time() - t_start, 2),
+    }
+    if not keep and workdir is None:
+        shutil.rmtree(base_dir, ignore_errors=True)
+    return result
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--out", default=None, help="write the JSON record here")
     p.add_argument("--workdir", default=None,
                    help="run under this directory (kept) instead of a "
                         "deleted tempdir")
+    p.add_argument("--fleet", action="store_true",
+                   help="also run the fleet chaos classes "
+                        "(killed/wedged worker subprocesses; slow)")
     opts = p.parse_args(argv)
     result = run_drill(log=lambda m: print(m, file=sys.stderr, flush=True),
                        workdir=opts.workdir, keep=bool(opts.workdir))
+    if opts.fleet:
+        result["fleet"] = run_fleet_drill(
+            log=lambda m: print(m, file=sys.stderr, flush=True),
+            workdir=(os.path.join(opts.workdir, "fleet")
+                     if opts.workdir else None),
+            keep=bool(opts.workdir))
+        result["all_ok"] = result["all_ok"] and result["fleet"]["all_ok"]
     print(json.dumps(result, indent=1))
     if opts.out:
         with open(opts.out, "w") as f:

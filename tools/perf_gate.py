@@ -6,7 +6,7 @@ committed baseline (direction-aware per-config headline values — see
 so the BENCH trajectory is *enforced* per PR, not just recorded.
 
 One-line CPU invocation (the committed ``BENCH_GATE_cpu.jsonl`` baseline,
-quick preset, the fast configs 1/7/10/11/12 — also wired as a
+quick preset, the fast configs 1/7/10/11/12/13/14 — also wired as a
 ``slow``-marked test in ``tests/test_obs.py``):
 
     JAX_PLATFORMS=cpu python tools/perf_gate.py
@@ -57,9 +57,12 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: kernel=auto A/B — its value drops to 0.0 when the autotuner's
 #: invariants break; 13: the N-beam batched-vs-sequential A/B — its
 #: value drops to 0.0 when any per-beam candidate table diverges from
-#: the sequential arm; all six run in tier-1-scale time)
+#: the sequential arm; 14: the 2-worker fleet-vs-single-process A/B —
+#: its value drops to 0.0 when any per-file ledger or candidate byte
+#: diverges or the fleet fails to finish; all seven run in
+#: tier-1-scale time)
 DEFAULT_BASELINE = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
-DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13)
+DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14)
 
 #: the committed tune-cache artifact the gate version-checks (the
 #: snapshot-schema rule of PR 5, applied to tuner measurements: a
@@ -86,8 +89,13 @@ DEFAULT_TUNE_ARTIFACT = os.path.join(REPO, "TUNE_cpu.json")
 #: Config 13 follows the same pattern as 12 — a quotient of two
 #: jittery CPU walls whose gated signal is the forced 0.0 on a
 #: per-beam byte divergence, so it takes the same wide bound.
+#: Config 14 is the same quotient-of-walls shape again (single-process
+#: vs 2-thread fleet on one CPU core): the gated signal is the forced
+#: 0.0 on a ledger/candidate byte divergence or an unfinished survey,
+#: so it takes the wall-clock bound too.
 #: Config 10 stays TIGHT: canary recall is deterministic, not jittery.
-DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75, 13: 0.75}
+DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75, 13: 0.75,
+                          14: 0.75}
 
 
 def run_suite(configs, preset, out_path):
