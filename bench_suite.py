@@ -43,6 +43,13 @@ all). Failures in one config don't stop the others.
      value = single-process/fleet wall ratio, forced to 0.0 when any
      per-file ledger or candidate byte diverges (the fleet may change
      speed, never science)
+ 15  packed low-bit vs host-unpack A/B on the streaming driver
+     (ISSUE 11): the same on-disk 2-bit file streamed twice — raw
+     packed bytes with in-jit device unpack + integer accumulation vs
+     host-unpacked float32 upload — value = host/packed wall ratio,
+     forced to 0.0 when any per-chunk table byte diverges or the
+     putpu_bytes_uploaded_total ratio falls below 8x (expect ~16x at
+     2 bits)
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -992,11 +999,99 @@ def config14(quick):
           "fleet_wall_s": round(fleet_wall, 2)})
 
 
+def config15(quick):
+    """Packed low-bit vs host-unpack A/B on the streaming driver
+    (ISSUE 11).  One on-disk 2-bit descending-band pulse file (the
+    config-7 generator) streamed twice through ``stream_search``:
+
+    * **host arm** — each chunk host-unpacked (the C++/numpy decoder)
+      and shipped as float32, the pre-round-11 data path;
+    * **packed arm** — each chunk shipped as the RAW packed bytes
+      (:class:`~pulsarutils_tpu.io.lowbit.PackedFrames`): the bit
+      unpack runs inside the search jit and the sweep accumulates in
+      the exact integer dtype.
+
+    ``value`` is the host/packed wall ratio — FORCED to 0.0, far past
+    any tolerance, when any per-chunk table byte diverges between the
+    arms or the measured ``putpu_bytes_uploaded_total`` ratio falls
+    below 8x (a 2-bit file must upload 1/16th the float32 bytes; on a
+    CPU runner with free "uploads" the wall ratio ~1 is expected — the
+    bytes ratio is the production-link win this config gates).
+    """
+    import tempfile
+
+    from pulsarutils_tpu.io.lowbit import PackedFrames
+    from pulsarutils_tpu.io.sigproc import FilterbankReader
+    from pulsarutils_tpu.obs import metrics as obs_metrics
+    from pulsarutils_tpu.parallel.stream import stream_search
+
+    ab = _load_tool("stream_budget_ab")
+    nchan = 256 if not quick else 64
+    hop = (1 << 15) if not quick else (1 << 12)
+    nhops = 6 if not quick else 4
+    nsamples = nhops * hop
+    step = 2 * hop
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "lowbit.fil")
+        ab.generate(path, nchan, nsamples, log, hop=hop,
+                    margin=min(2048, hop // 4))
+        reader = FilterbankReader(path)
+        fb, bw = ab.FBOT, ab.FTOP - ab.FBOT
+        starts = [s for s in range(0, nsamples, step)]
+        host_chunks = [(s, reader.read_block(
+            s, step, band_ascending=True).astype(np.float32))
+            for s in starts]
+        packed_chunks = [(s, PackedFrames.read(reader, s, step))
+                         for s in starts]
+
+        def arm(chunks):
+            t0 = time.perf_counter()
+            results, hits = stream_search(chunks, ab.DMMIN, ab.DMMAX,
+                                          fb, bw, ab.TSAMP)
+            return results, hits, time.perf_counter() - t0
+
+        up = obs_metrics.counter("putpu_bytes_uploaded_total")
+        arm(host_chunks)  # warm-up: compiles out of the timed region
+        b0 = up.value
+        res_h, hits_h, host_wall = arm(host_chunks)
+        host_bytes = up.value - b0
+        arm(packed_chunks)
+        b0 = up.value
+        res_p, hits_p, packed_wall = arm(packed_chunks)
+        packed_bytes = up.value - b0
+
+    identical = len(res_h) == len(res_p)
+    if identical:
+        for (i1, t1), (i2, t2) in zip(res_h, res_p):
+            if i1 != i2 or t1.colnames != t2.colnames or any(
+                    not np.array_equal(np.asarray(t1[c]),
+                                       np.asarray(t2[c]))
+                    for c in t1.colnames):
+                identical = False
+                log(f"config 15: chunk {i1} tables diverge")
+                break
+    bytes_ratio = host_bytes / packed_bytes if packed_bytes else 0.0
+    ok = identical and bytes_ratio >= 8.0
+    emit({"config": 15, "metric": "packed 2-bit vs host-unpack A/B on "
+          f"the streaming driver, {nchan}x{nsamples}, "
+          f"{len(starts)} chunks",
+          "value": round(host_wall / packed_wall, 4) if ok else 0.0,
+          "unit": "x (host-unpack/packed wall; 0 = identity or "
+                  "bytes-ratio failure)",
+          "tables_identical": identical,
+          "bytes_uploaded": {"host": int(host_bytes),
+                             "packed": int(packed_bytes),
+                             "ratio": round(bytes_ratio, 2)},
+          "host_wall_s": round(host_wall, 4),
+          "packed_wall_s": round(packed_wall, 4),
+          "hits": {"host": len(hits_h), "packed": len(hits_p)}})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13, 14])
+                                 13, 14, 15])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -1024,7 +1119,8 @@ def main(argv=None):
         pass
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13, 14: config14}
+           11: config11, 12: config12, 13: config13, 14: config14,
+           15: config15}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

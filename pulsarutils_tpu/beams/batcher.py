@@ -42,32 +42,87 @@ class BeamGeometryError(ValueError):
     """Beams offered for one batch do not share a chunk geometry."""
 
 
+def _beam_body(chan_block, formulation, packed, prep):
+    """The per-beam traceable body shared by the batched and
+    single-beam kernels — ONE definition, so the two programs can never
+    drift and the bit-identity contract is structural.
+
+    ``packed`` (a :meth:`~pulsarutils_tpu.io.lowbit.PackedFrames.meta`
+    tuple) makes the beam operand the RAW ``(T, bytes_per_frame)``
+    uint8 frames, unpacked in-jit (ISSUE 11): an N-beam batch uploads
+    N stacks of packed bytes — 1/8-1/16th the float32 link traffic.
+    ``prep`` = ``(renormalize, resample)`` moves the multibeam driver's
+    per-beam conditioning into the same program (device clean), so a
+    packed beam-chunk never exists as host floats at all.
+    """
+    def body(beam, offset_blocks):
+        import jax.numpy as jnp
+
+        if packed is not None:
+            from ..io.lowbit import unpack_from_meta
+
+            beam = unpack_from_meta(beam, packed, jnp)
+        if prep is not None:
+            renorm, resample = prep
+            if renorm:
+                from ..ops.clean_ops import renormalize_data
+
+                beam = renormalize_data(beam, xp=jnp)
+            if resample > 1:
+                from ..ops.rebin import quick_resample
+
+                beam = quick_resample(beam, resample, xp=jnp)
+        return search_kernel_fn(beam, offset_blocks,
+                                capture_plane=False,
+                                chan_block=chan_block,
+                                formulation=formulation)
+
+    return body
+
+
 @functools.lru_cache(maxsize=PLAN_CACHE_SIZE)
-def batched_search_kernel(chan_block, formulation):
+def batched_search_kernel(chan_block, formulation, packed=None, prep=None):
     """ONE jitted program: ``lax.map`` over the beam axis of the
     single-beam search kernel.
 
-    Input ``data`` is ``(batch, nchan, T)``; ``offset_blocks`` the
-    shared ``(nblocks, dm_block, nchan)`` int32 table (same geometry =
-    same offsets for every beam).  Output is ``(batch, nblocks, 5,
+    Input ``data`` is ``(batch, nchan, T)`` — or ``(batch, T,
+    bytes_per_frame)`` raw packed frames with ``packed`` set (the
+    per-beam in-jit unpack of ISSUE 11); ``offset_blocks`` the shared
+    ``(nblocks, dm_block, nchan)`` int32 table (same geometry = same
+    offsets for every beam).  Output is ``(batch, nblocks, 5,
     dm_block)`` stacked score packs.  The per-beam body is literally
-    :func:`~pulsarutils_tpu.ops.search.search_kernel_fn` — the same
-    trace the single-beam ``_jax_search_kernel`` jits — so each beam's
-    float operations (and therefore its scores) are bit-identical to a
-    sequential single-beam run.  One compiled program serves every
-    batch width per (batch, nchan, T) shape; interior survey chunks
-    share one shape by construction, so steady state is retrace-free.
+    :func:`~pulsarutils_tpu.ops.search.search_kernel_fn` (via
+    :func:`_beam_body`) — the same trace the single-beam kernels jit —
+    so each beam's float operations (and therefore its scores) are
+    bit-identical to a sequential single-beam run.  One compiled
+    program serves every batch width per (batch, nchan, T) shape;
+    interior survey chunks share one shape by construction, so steady
+    state is retrace-free.
     """
     import jax
 
+    body = _beam_body(chan_block, formulation, packed, prep)
+
     @jax.jit
     def kernel(data, offset_blocks):
-        return jax.lax.map(
-            lambda beam: search_kernel_fn(beam, offset_blocks,
-                                          capture_plane=False,
-                                          chan_block=chan_block,
-                                          formulation=formulation),
-            data)
+        return jax.lax.map(lambda beam: body(beam, offset_blocks), data)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=PLAN_CACHE_SIZE)
+def single_beam_kernel(chan_block, formulation, packed=None, prep=None):
+    """The sequential arm for packed/prep batchers: the SAME per-beam
+    body as :func:`batched_search_kernel`, without the batch map — the
+    bit-identity reference (and the host-unpack A/B partner when fed
+    float codes with ``packed=None``)."""
+    import jax
+
+    body = _beam_body(chan_block, formulation, packed, prep)
+
+    @jax.jit
+    def kernel(beam, offset_blocks):
+        return body(beam, offset_blocks)
 
     return kernel
 
@@ -136,11 +191,24 @@ class BeamBatcher:
     batch-keyed ladder (static fallback: roll on CPU, gather
     elsewhere — the measured PR 1 heuristic restricted to the
     formulations that can ride inside the batch map).
+
+    ``packed`` = ``(nbits, band_descending)`` puts the batcher on the
+    packed low-bit path (ISSUE 11): :meth:`search` then takes each
+    beam's RAW ``(nsamps, bytes_per_frame)`` uint8 frames, stacks the
+    packed bytes and unpacks per beam INSIDE the one jitted program —
+    N beam-chunks upload 1/8-1/16th the float32 bytes, with scores
+    byte-identical to feeding the host-unpacked codes (the decode is
+    integer-exact and the downstream graph is the same trace).  With
+    no ``prep``, the sweep additionally accumulates in the exact
+    integer dtype (:func:`~pulsarutils_tpu.io.lowbit.accum_dtype`).
+    ``prep`` = ``(renormalize, resample)`` moves the per-beam
+    conditioning into the same program (device clean) — the multibeam
+    driver's packed mode sets both.
     """
 
     def __init__(self, nchan, nsamples, trial_dms, start_freq, bandwidth,
                  sample_time, *, dm_block=None, chan_block=None,
-                 kernel=None, batch_hint=1):
+                 kernel=None, batch_hint=1, packed=None, prep=None):
         self.nchan = int(nchan)
         self.nsamples = int(nsamples)
         self.trial_dms = np.asarray(trial_dms, dtype=np.float64)
@@ -174,6 +242,20 @@ class BeamBatcher:
                 "formulations ('roll'/'gather') can ride inside the "
                 "batch map")
         self.kernel = kernel
+        self.prep = ((bool(prep[0]), int(prep[1]))
+                     if prep is not None else None)
+        self.packed_meta = None
+        if packed is not None:
+            from ..io.lowbit import accum_dtype
+
+            nbits, descending = packed
+            # integer sweep accumulation only when nothing downstream
+            # needs floats (no renormalisation) and the exactness bound
+            # holds; conditioning paths unpack straight to float32
+            acc = (accum_dtype(nbits, self.nchan)
+                   if self.prep is None else None) or "float32"
+            self.packed_meta = (int(nbits), self.nchan, bool(descending),
+                                acc)
         # per-series-length device offset tables: interior chunks share
         # one (the bound ``nsamples``); a ragged final chunk gets its
         # own (the gather wraps mod T, so offsets are length-specific) —
@@ -203,11 +285,27 @@ class BeamBatcher:
                 f"beam blocks of one batch must share a shape; got "
                 f"{sorted(shapes)} — same-geometry chunks only")
         shape = next(iter(shapes))
+        if self.packed_meta is not None:
+            nbits = self.packed_meta[0]
+            bpf = self.nchan * nbits // 8
+            if len(shape) != 2 or shape[1] != bpf:
+                raise BeamGeometryError(
+                    f"packed beam blocks have shape {shape}; this "
+                    f"batcher expects raw (nsamps, {bpf}) frames at "
+                    f"{nbits} bits x {self.nchan} channels")
+            return shape[0]
         if len(shape) != 2 or shape[0] != self.nchan:
             raise BeamGeometryError(
                 f"beam blocks have shape {shape}; this batcher is bound "
                 f"to {self.nchan} channels")
         return shape[1]
+
+    def _searched_len(self, raw_len):
+        """Post-prep series length (= the offset-table key): the in-jit
+        resample truncates exactly like the host ``quick_resample``."""
+        if self.prep is not None and self.prep[1] > 1:
+            return int(raw_len) // self.prep[1]
+        return int(raw_len)
 
     def _tables(self, stacked):
         tables = []
@@ -222,24 +320,45 @@ class BeamBatcher:
                 "snr": snrs, "rebin": windows, "peak": peaks}))
         return tables
 
+    def _stack(self, blocks):
+        """Device stack + the upload accounting: packed batchers ship
+        the RAW bytes (uint8) and count the link savings."""
+        import jax.numpy as jnp
+
+        from ..obs import metrics as obs_metrics
+
+        if self.packed_meta is not None:
+            data = jnp.stack([jnp.asarray(b) for b in blocks])
+            obs_metrics.counter("putpu_lowbit_packed_chunks_total").inc(
+                len(blocks))
+            obs_metrics.counter("putpu_lowbit_bytes_saved_total").inc(
+                sum(self.nchan * int(np.shape(b)[0]) * 4
+                    - int(getattr(b, "nbytes", 0)) for b in blocks))
+        else:
+            data = jnp.stack([jnp.asarray(b, dtype=jnp.float32)
+                              for b in blocks])
+        obs_metrics.counter("putpu_bytes_uploaded_total").inc(
+            int(data.nbytes))
+        return data
+
     def search(self, blocks):
         """Search one chunk epoch across all beams in ONE dispatch.
 
         ``blocks`` is a sequence of B ``(nchan, nsamples)`` arrays (one
-        per beam, any host/device mix).  Returns B result tables whose
-        columns are bit-identical to B sequential :meth:`search_single`
-        calls.  Budget: one ``dispatches`` + one ``readbacks`` count
-        for the whole batch — that 2 vs ``2B`` trip count is the entire
-        point (config 13 gates it).
+        per beam, any host/device mix) — or B raw ``(nsamps,
+        bytes_per_frame)`` packed frames on a ``packed`` batcher.
+        Returns B result tables whose columns are bit-identical to B
+        sequential :meth:`search_single` calls.  Budget: one
+        ``dispatches`` + one ``readbacks`` count for the whole batch —
+        that 2 vs ``2B`` trip count is the entire point (config 13
+        gates it).
         """
-        import jax.numpy as jnp
-
-        nsamples = self._check(blocks)
-        kernel = batched_search_kernel(self.chan_block, self.kernel)
+        raw_len = self._check(blocks)
+        kernel = batched_search_kernel(self.chan_block, self.kernel,
+                                       self.packed_meta, self.prep)
         with budget_bucket("search/dispatch"):
-            offs_dev = self._offsets_dev(nsamples)
-            data = jnp.stack([jnp.asarray(b, dtype=jnp.float32)
-                              for b in blocks])
+            offs_dev = self._offsets_dev(self._searched_len(raw_len))
+            data = self._stack(blocks)
             out = kernel(data, offs_dev)
             budget_count("dispatches")
         with budget_bucket("search/readback"):
@@ -250,17 +369,30 @@ class BeamBatcher:
     def search_single(self, block):
         """One beam through the plain single-beam compiled kernel — the
         sequential arm of the A/B, and the bit-identity reference the
-        batched path is pinned against."""
+        batched path is pinned against.  Packed/prep batchers route
+        through :func:`single_beam_kernel` (the SAME per-beam body as
+        the batched program); plain batchers keep the original
+        ``ops.search`` kernel."""
         import jax.numpy as jnp
 
-        from ..ops.search import _jax_search_kernel
+        raw_len = self._check([block])
+        searched = self._searched_len(raw_len)
+        if self.packed_meta is not None or self.prep is not None:
+            kernel = single_beam_kernel(self.chan_block, self.kernel,
+                                        self.packed_meta, self.prep)
 
-        nsamples = self._check([block])
-        kernel = _jax_search_kernel(False, self.chan_block, self.kernel)
+            def operand():
+                return self._stack([block])[0]
+        else:
+            from ..ops.search import _jax_search_kernel
+
+            kernel = _jax_search_kernel(False, self.chan_block, self.kernel)
+
+            def operand():
+                return jnp.asarray(block, dtype=jnp.float32)
         with budget_bucket("search/dispatch"):
-            offs_dev = self._offsets_dev(nsamples)
-            out = kernel(jnp.asarray(block, dtype=jnp.float32),
-                         offs_dev)
+            offs_dev = self._offsets_dev(searched)
+            out = kernel(operand(), offs_dev)
             budget_count("dispatches")
         with budget_bucket("search/readback"):
             stacked = np.asarray(out)

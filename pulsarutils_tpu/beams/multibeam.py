@@ -109,7 +109,7 @@ def multibeam_search(fnames, dmmin=200, dmmax=800, *, snr_threshold=6.0,
                      canary_seed=0, coincidence=True, veto_frac=0.7,
                      max_real_beams=2, adjacency=None, budget=None,
                      progress_cb=None, cancel_cb=None, keep_tables=False,
-                     store_factory=None):
+                     store_factory=None, packed="auto"):
     """Search N same-geometry filterbanks as one batched survey.
 
     Returns a result dict::
@@ -129,6 +129,27 @@ def multibeam_search(fnames, dmmin=200, dmmax=800, *, snr_threshold=6.0,
     ledger) while the other beams keep going.  ``store_factory(i,
     fname, fingerprint)`` overrides per-beam store construction (the
     service roots each job's store in the job's own output directory).
+
+    ``packed`` (ISSUE 11) selects the low-bit data path:
+
+    * ``"auto"`` (default) — ``"device"`` when every beam file is a
+      packed 1/2/4-bit single-IF filterbank, ``"off"`` otherwise;
+    * ``"device"`` / ``True`` — each beam's RAW packed bytes are read,
+      canary-injected in the packed domain, stacked and unpacked **per
+      beam inside the one batched program**, with the per-beam
+      conditioning (renormalise + resample) in the same jit: an N-beam
+      chunk epoch uploads 1/8-1/16th the float32 bytes;
+    * ``"host"`` — the byte-identity A/B arm: the same in-jit
+      conditioning fed host-unpacked float codes (identical floats, at
+      float32 upload cost);
+    * ``"off"`` / ``False`` — the legacy host-side clean (the only
+      mode for 8/16/32-bit files, whose path is unchanged).
+
+    ``"device"`` and ``"host"`` produce byte-identical per-beam tables,
+    ledgers and candidates (pinned in ``tests/test_lowbit_e2e.py``);
+    both differ from ``"off"`` on low-bit files, whose conditioning
+    used to run host-side in float64 — the packed path is the default
+    there now, which is the point of ISSUE 11.
     """
     if not fnames:
         raise ValueError("multibeam_search needs at least one filterbank")
@@ -148,6 +169,29 @@ def multibeam_search(fnames, dmmin=200, dmmax=800, *, snr_threshold=6.0,
             "common %d-sample prefix",
             sorted({r.nsamples for r in readers}), nsamples)
 
+    # -- low-bit data-path resolution (ISSUE 11) ------------------------
+    lowbit_ok = (all(r._nbits in (1, 2, 4) and r.nifs == 1
+                     for r in readers)
+                 and len({r._nbits for r in readers}) == 1)
+    if packed == "auto":
+        mode = "device" if lowbit_ok else "off"
+    elif packed in (True, "device"):
+        mode = "device"
+    elif packed == "host":
+        mode = "host"
+    elif packed in (False, "off", None):
+        mode = "off"
+    else:
+        raise ValueError(f"packed={packed!r}: expected 'auto', 'device', "
+                         "'host' or 'off'")
+    if mode in ("device", "host") and not lowbit_ok:
+        raise ValueError(
+            "packed mode needs every beam file packed at one shared "
+            "1/2/4-bit single-IF format; pass packed='off' for mixed "
+            "or full-rate files")
+    nbits = readers[0]._nbits if lowbit_ok else 0
+    descending = readers[0].band_descending
+
     plan = plan_chunks(nsamples, sample_time, dmmin, dmmax, start_freq,
                        stop_freq, foff, chunk_length=chunk_length,
                        new_sample_time=new_sample_time)
@@ -155,13 +199,20 @@ def multibeam_search(fnames, dmmin=200, dmmax=800, *, snr_threshold=6.0,
     trial_dms = dedispersion_plan(nchan, dmmin, dmmax, start_freq,
                                   bandwidth, eff_tsamp)
     nsamp_eff = plan.step // plan.resample
-    batcher = BeamBatcher(nchan, nsamp_eff, trial_dms, start_freq,
-                          bandwidth, eff_tsamp, kernel=kernel,
-                          batch_hint=nbeams)
+    batcher = BeamBatcher(
+        nchan, nsamp_eff, trial_dms, start_freq, bandwidth, eff_tsamp,
+        kernel=kernel, batch_hint=nbeams,
+        # device mode ships raw packed bytes (per-beam in-jit unpack);
+        # both packed modes move the per-beam conditioning into the
+        # batched program so the two arms share one float pipeline
+        packed=(nbits, descending) if mode == "device" else None,
+        prep=(True, plan.resample) if mode != "off" else None)
     logger.info("multibeam: %d beams, chunk plan step=%d hop=%d "
-                "resample=%d, %d trials, kernel=%s, %s dispatch",
+                "resample=%d, %d trials, kernel=%s, %s dispatch, "
+                "data path=%s",
                 nbeams, plan.step, plan.hop, plan.resample, len(trial_dms),
-                batcher.kernel, "batched" if batched else "sequential")
+                batcher.kernel, "batched" if batched else "sequential",
+                mode if mode != "off" else "host-clean")
 
     timer = budget if budget is not None else BudgetAccountant()
     timer.begin_stream()
@@ -235,14 +286,37 @@ def multibeam_search(fnames, dmmin=200, dmmax=800, *, snr_threshold=6.0,
             with timer.bucket("read"):
                 for i in pending:
                     b = beams[i]
+                    if mode != "off":
+                        # packed low-bit path: raw bytes off the mmap,
+                        # canary quantized into the codes on this
+                        # thread; "host" decodes here (the identity
+                        # A/B arm), "device" ships the bytes as-is
+                        raw = b["reader"].read_block_packed(istart,
+                                                            chunk_size)
+                        if b["canary"] is not None:
+                            raw = b["canary"].maybe_inject_packed(
+                                raw, istart, nbits=nbits, nchan=nchan,
+                                band_descending=descending)
+                        if mode == "host":
+                            from ..io.lowbit import PackedFrames
+
+                            blocks[i] = PackedFrames(
+                                raw, nbits, nchan,
+                                band_descending=descending).to_host()
+                        else:
+                            blocks[i] = raw
+                        continue
                     block = b["reader"].read_block(istart, chunk_size,
                                                    band_ascending=True)
                     if b["canary"] is not None:
                         block = b["canary"].maybe_inject(block, istart)
                     blocks[i] = block
-            with timer.bucket("clean"):
-                for i in pending:
-                    blocks[i] = _clean_block(blocks[i], plan.resample)
+            if mode == "off":
+                # packed modes condition INSIDE the batched program
+                # (BeamBatcher prep); the legacy path cleans host-side
+                with timer.bucket("clean"):
+                    for i in pending:
+                        blocks[i] = _clean_block(blocks[i], plan.resample)
 
             t_chunk = time.perf_counter()
             with timer.bucket("search"):
@@ -301,7 +375,22 @@ def multibeam_search(fnames, dmmin=200, dmmax=800, *, snr_threshold=6.0,
 
                 payload = None
                 if is_hit:
-                    array = blocks[i]
+                    if mode == "device":
+                        # diagnostics waterfall for the (rare) hit:
+                        # host decode + host clean of exactly the bytes
+                        # the device searched — identical across the
+                        # device/host arms, so candidate files stay
+                        # byte-identical
+                        from ..io.lowbit import PackedFrames
+
+                        array = _clean_block(PackedFrames(
+                            blocks[i], nbits, nchan,
+                            band_descending=descending).to_host(),
+                            plan.resample)
+                    elif mode == "host":
+                        array = _clean_block(blocks[i], plan.resample)
+                    else:
+                        array = blocks[i]
                     info = PulseInfo(
                         allprofs=array, start_freq=start_freq,
                         bandwidth=bandwidth, nbin=array.shape[1],

@@ -13,7 +13,13 @@ This module holds the *decisions* the hardened survey loop makes when
   saturation and zero-run fractions against configurable thresholds.
   Recoverable chunks are **sanitized** (non-finite values imputed with
   the per-channel median, counted); unrecoverable ones are
-  **quarantined** instead of poisoning the S/N statistics or crashing;
+  **quarantined** instead of poisoning the S/N statistics or crashing.
+  Low-bit (1/2/4-bit) data gets the CODE-domain gate instead
+  (:func:`gate_chunk_packed` / :func:`gate_chunk_lowbit`, ISSUE 11):
+  rail/zero/dead-channel fractions computed from the raw packed bytes
+  with thresholds rescaled onto the quantization floor — strict/
+  sanitize policies now work on low-bit files instead of silently
+  passing;
 * :class:`QuarantineManifest` — the ``quarantine_<fingerprint>.jsonl``
   record of every quarantined chunk and persist dead-letter (chunk
   span, reason, stats), the artifact the end-of-run audit
@@ -223,6 +229,97 @@ def gate_chunk(block, policy):
     med = np.where(np.isfinite(med), med, 0.0)
     out = np.where(finite, block_arr, med[:, None])
     return out, {"verdict": "sanitized", "stats": stats, "reasons": []}
+
+
+def lowbit_code_stats(codes, nbits):
+    """Integrity statistics of a low-bit CODE block (ISSUE 11).
+
+    ``codes`` is ``(nchan, n)`` quantization codes (integer values
+    ``0..2^nbits - 1``, any numeric dtype — the decoded floats a host
+    unpack yields are exact codes too).  The float-domain
+    :func:`chunk_stats` is meaningless here — low-bit data cannot hold
+    NaN/Inf, and its zero/saturation fractions sit at the quantization
+    levels *by construction* (a healthy 1-bit chunk is ~50% at each
+    rail), which is why the gate used to skip quantized data entirely
+    (PR 4) and silently passed genuinely broken low-bit chunks.  These
+    are the code-domain equivalents:
+
+    * ``zero_frac`` — codes at the bottom rail (dropped packets, a dead
+      digitiser leg);
+    * ``rail_frac`` — codes pinned at the TOP rail (clipped digitiser,
+      persistent broadband RFI saturating the quantizer);
+    * ``dead_frac`` — channels whose codes never change over the
+      sample (a flat channel carries no signal and biases the
+      renormalisation).
+    """
+    codes = np.asarray(codes)
+    mask = (1 << int(nbits)) - 1
+    zero_frac = float((codes == 0).mean())
+    rail_frac = float((codes == mask).mean())
+    dead_frac = float((codes.max(axis=1) == codes.min(axis=1)).mean())
+    return {"zero_frac": zero_frac, "rail_frac": rail_frac,
+            "dead_frac": dead_frac, "nbits": int(nbits)}
+
+
+def _lowbit_verdict(raw, nbits, policy):
+    """Code-domain gate rule shared by the packed and host-decoded
+    low-bit paths.  The zero/rail thresholds are RESCALED onto the
+    quantization floor: a uniform healthy code distribution already
+    puts ``2^-nbits`` of the samples on each rail, so the policy's
+    float-domain fraction limits are interpreted as *how far toward
+    100% the excess may go* — ``limit' = expected + (1 - expected) *
+    limit``.  At 2 bits with the default ``max_zero_frac=0.75`` that is
+    0.8125 (healthy ~0.25 passes, a dropped-packet chunk at ~1.0
+    trips); at 1 bit the default saturation limit resolves to 0.75
+    (healthy ~0.5 passes, a clipped chunk at ~1.0 trips).
+    ``dead_frac`` needs no rescale — channel flatness is
+    rate-independent.  There is nothing to sanitize in integer codes
+    (no NaN to impute), so ``"strict"`` and ``"sanitize"`` behave
+    identically here: clean or quarantine.
+    """
+    expected = 2.0 ** -int(nbits)
+    zero_lim = expected + (1.0 - expected) * policy.max_zero_frac
+    rail_lim = expected + (1.0 - expected) * policy.max_sat_frac
+    stats = {k: (round(v, 6) if isinstance(v, float) else v)
+             for k, v in raw.items()}
+    reasons = [name for name, frac, lim in (
+        ("zero_frac", raw["zero_frac"], zero_lim),
+        ("rail_frac", raw["rail_frac"], rail_lim),
+        ("dead_frac", raw["dead_frac"], policy.max_dead_frac),
+    ) if frac > lim]
+    if reasons:
+        return {"verdict": "quarantine", "stats": stats,
+                "reasons": reasons}
+    return {"verdict": "clean", "stats": stats, "reasons": []}
+
+
+def gate_chunk_packed(frames, nbits, nchan, policy, max_rows=4096):
+    """Gate one PACKED low-bit chunk from its raw bytes (ISSUE 11).
+
+    ``frames`` is the raw ``(nsamps, bytes_per_frame)`` uint8 block the
+    packed fast path ships to the device.  A bounded strided row
+    subsample (``max_rows`` frames) is decoded with cheap shift/mask
+    stats — the reader thread never pays a full-chunk unpack — and the
+    code-domain verdict rule (:func:`_lowbit_verdict`) applies.  The
+    frames are returned untouched either way: the gate must never
+    perturb the byte-exact upload.
+    """
+    from ..io.lowbit import sample_codes
+
+    frames = np.asarray(frames)
+    codes = sample_codes(frames, nbits, nchan, max_rows=max_rows)
+    return frames, _lowbit_verdict(lowbit_code_stats(codes, nbits),
+                                   nbits, policy)
+
+
+def gate_chunk_lowbit(block, nbits, policy, max_cols=4096):
+    """Gate one host-DECODED low-bit chunk (the numpy-backend path):
+    same code-domain rule as :func:`gate_chunk_packed`, computed from a
+    strided column subsample of the float code block."""
+    block = np.asarray(block)
+    stride = max(1, block.shape[1] // int(max_cols))
+    return block, _lowbit_verdict(
+        lowbit_code_stats(block[:, ::stride], nbits), nbits, policy)
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,7 @@ loads, unless ``PUTPU_NO_NATIVE=1``.
 from __future__ import annotations
 
 import ctypes
+import functools
 import logging
 import os
 import subprocess
@@ -136,8 +137,33 @@ def native_available():
     return _load() is not None
 
 
+def accum_dtype(nbits, nchan):
+    """Name of the smallest integer dtype that EXACTLY holds a
+    full-channel dedispersion sum of ``nbits``-bit codes — the
+    integer-sweep-accumulation contract (ISSUE 11):
+
+    * a worst-case sum is ``(2^nbits - 1) * nchan`` (every channel at
+      the top rail);
+    * below 2^15 the whole ``(ndm, T)`` plane accumulates in **int16**
+      (half the HBM traffic of float32 on the memory-bound sweep);
+    * below 2^24 it accumulates in **int32** AND its float32 view is
+      still exact (float32 represents every integer < 2^24), so the
+      scores computed from the integer plane are bit-identical to the
+      float-accumulated reference — float32 addition of exact integers
+      with an exact-representable running sum never rounds;
+    * at or above 2^24 the exactness argument breaks and callers must
+      stay on the float32 path (``None`` is returned).
+    """
+    peak = ((1 << int(nbits)) - 1) * int(nchan)
+    if peak < (1 << 15):
+        return "int16"
+    if peak < (1 << 24):
+        return "int32"
+    return None
+
+
 def device_unpack_block(frames, nbits, nchan, band_descending=False,
-                        xp=None):
+                        xp=None, dtype=None):
     """Jittable device unpack: packed frames -> ``(nchan, n)`` float32.
 
     ``frames`` is the raw ``(nsamps, nbytes_per_frame)`` uint8 block a
@@ -153,6 +179,11 @@ def device_unpack_block(frames, nbits, nchan, band_descending=False,
     links (measured 647 s per 4 GB chunk on a congested tunnel).
     Uploading the packed bytes and unpacking in the device-clean jit
     moves the inflation to HBM, where it is free by comparison.
+
+    ``dtype`` (round 11) overrides the output dtype: an integer dtype
+    (see :func:`accum_dtype`) keeps the unpacked codes integral so the
+    dedispersion sweep can accumulate in int16/int32 — same values,
+    half the HBM traffic — converting to float only at scoring.
     """
     if xp is None:
         import jax.numpy as xp
@@ -162,10 +193,151 @@ def device_unpack_block(frames, nbits, nchan, band_descending=False,
     shifts = xp.arange(per, dtype=xp.uint8) * np.uint8(nbits)
     vals = (frames[:, :, None] >> shifts[None, None, :]) & np.uint8(mask)
     block = vals.reshape(frames.shape[0], -1)[:, :nchan]
-    block = block.astype(xp.float32).T
+    block = block.astype(dtype if dtype is not None else xp.float32).T
     if band_descending:
         block = block[::-1]
     return block
+
+
+def unpack_from_meta(data, meta, xp):
+    """In-jit unpack from a :meth:`PackedFrames.meta` tuple.
+
+    The ONE traceable body every surface embeds (direct-sweep kernel,
+    batched beam body, both shard_map programs) — so the meta's dtype
+    element is always honored and the bit-identity-critical unpack
+    cannot drift between copies.
+    """
+    nbits, nchan, descending, dtype_name = meta
+    return device_unpack_block(data, nbits, nchan,
+                               band_descending=descending, xp=xp,
+                               dtype=getattr(xp, dtype_name))
+
+
+def sample_codes(frames, nbits, nchan, max_rows=4096):
+    """Bounded strided decode of packed frames -> ``(nchan, k)`` codes
+    in FILE channel order.
+
+    Shared by the reader-thread consumers that need statistics, not the
+    whole chunk (the packed canary's noise scale, the code-domain
+    integrity gate): at most ``max_rows`` frames are decoded regardless
+    of chunk size.
+    """
+    frames = np.asarray(frames)
+    stride = max(1, frames.shape[0] // int(max_rows))
+    per_frame = frames.shape[1] * _PER_BYTE[nbits]
+    return unpack_numpy(frames[::stride], nbits).reshape(
+        -1, per_frame)[:, :int(nchan)].T
+
+
+@functools.lru_cache(maxsize=16)
+def _unpack_program(nbits, nchan, band_descending, dtype_name):
+    """ONE compiled device-unpack program per (geometry, dtype): raw
+    packed bytes in, ``(nchan, n)`` block out.  Shared by every surface
+    that uploads packed frames but runs a kernel that cannot unpack
+    in-program (Pallas/FDMT/fourier, the mesh exact sweep): the link
+    still carries 1/8-1/16th the bytes, the shift/mask inflation
+    happens on HBM."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = getattr(jnp, dtype_name)
+
+    @jax.jit
+    def run(frames):
+        return device_unpack_block(frames, nbits, nchan,
+                                   band_descending=band_descending,
+                                   xp=jnp, dtype=dtype)
+
+    return run
+
+
+class PackedFrames:
+    """A packed low-bit chunk in transit: raw SIGPROC frames plus the
+    metadata needed to decode them.
+
+    This is the carrier every scaled dispatch surface accepts in place
+    of a float ``(nchan, n)`` block (ISSUE 11): the streaming driver
+    (``parallel/stream.py``), the mesh searches
+    (``parallel/sharded_fdmt.py`` / ``parallel/sharded.py``), the
+    batched beam dispatcher (``beams/batcher.py``) and the single-device
+    facade (``ops/search.py``).  ``frames`` is exactly what
+    ``FilterbankReader.read_block_packed`` returns — ``(nsamps,
+    bytes_per_frame)`` uint8 — so shipping it to the device costs
+    ``nbits/32`` of the float32 upload.  ``.shape`` reports the LOGICAL
+    ``(nchan, nsamps)`` block shape so geometry-planning code
+    (``np.shape(data)``) works unchanged.
+    """
+
+    __slots__ = ("frames", "nbits", "nchan", "band_descending")
+
+    def __init__(self, frames, nbits, nchan, band_descending=False):
+        if nbits not in _PER_BYTE:
+            raise ValueError(f"unsupported nbits={nbits}")
+        self.frames = np.asarray(frames)
+        if self.frames.ndim != 2 or self.frames.dtype != np.uint8:
+            raise ValueError(
+                "PackedFrames wants the raw (nsamps, bytes_per_frame) "
+                f"uint8 frames; got {self.frames.dtype} "
+                f"{self.frames.shape}")
+        self.nbits = int(nbits)
+        self.nchan = int(nchan)
+        self.band_descending = bool(band_descending)
+
+    @classmethod
+    def read(cls, reader, istart, nsamps):
+        """Read one packed chunk off a low-bit single-IF
+        :class:`~pulsarutils_tpu.io.sigproc.FilterbankReader`."""
+        return cls(reader.read_block_packed(istart, nsamps),
+                   reader._nbits, reader.nchans,
+                   band_descending=reader.band_descending)
+
+    @property
+    def shape(self):
+        """Logical decoded shape ``(nchan, nsamps)``."""
+        return (self.nchan, int(self.frames.shape[0]))
+
+    @property
+    def nsamps(self):
+        return int(self.frames.shape[0])
+
+    @property
+    def nbytes(self):
+        """Bytes actually shipped over the link (the packed bytes)."""
+        return int(self.frames.nbytes)
+
+    @property
+    def float_nbytes(self):
+        """Bytes the host-unpack path would have shipped (float32)."""
+        return self.nchan * self.nsamps * 4
+
+    def meta(self, dtype_name="float32"):
+        """Hashable unpack descriptor ``(nbits, nchan, descending,
+        dtype)`` — the static operand in-jit unpackers key on."""
+        return (self.nbits, self.nchan, self.band_descending,
+                str(dtype_name))
+
+    def to_device(self, dtype_name="float32"):
+        """Upload the PACKED bytes and unpack on device.
+
+        Returns the device-resident ``(nchan, nsamps)`` ascending-band
+        block (float32 by default, or an :func:`accum_dtype` integer
+        dtype) — one cached compiled program per geometry, so steady
+        state never retraces.
+        """
+        return _unpack_program(self.nbits, self.nchan,
+                               self.band_descending,
+                               str(dtype_name))(self.frames)
+
+    def to_host(self):
+        """Host decode (C++ when built, numpy otherwise) to the float32
+        ``(nchan, nsamps)`` ascending-band block — the fallback path and
+        the byte-identity oracle the device unpack is pinned against."""
+        per_frame = self.frames.shape[1] * _PER_BYTE[self.nbits]
+        block = unpack(self.frames, self.nbits).reshape(
+            self.nsamps, per_frame)[:, :self.nchan].T
+        if self.band_descending:
+            block = block[::-1]
+        return np.ascontiguousarray(block)
 
 
 def unpack_numpy(packed, nbits):

@@ -231,6 +231,71 @@ class CanaryController:
                 "dm": self.dm, "snr": self.snr, "width": self._width}
         return out
 
+    def maybe_inject_packed(self, frames, chunk, *, nbits, nchan,
+                            band_descending=False):
+        """Inject the canary track into PACKED low-bit frames (ISSUE 11).
+
+        The packed fast path uploads raw 1/2/4-bit bytes and unpacks on
+        device, so a float-domain bump has no seam there — instead the
+        matched-filter amplitude is **quantized into the low-bit codes**
+        on this (reader) thread and only the affected bytes are
+        re-packed: per lit ``(channel, sample)`` the stored code becomes
+        ``clip(round(code + amp_c), 0, 2^nbits - 1)``.  The device
+        signature is therefore *exact* — whatever unpacks those bytes
+        (device jit, host fallback, any mesh) sees identical values —
+        and recall gauges work on packed runs.  Chunk selection, the
+        injected ``t0`` and the pending-expectation record are shared
+        with :meth:`maybe_inject` (same rng keys), so a packed run
+        injects into exactly the chunks the float path would.
+
+        ``frames`` is the raw ``(nsamps, bytes_per_frame)`` uint8 block;
+        returns a modified copy when this chunk is selected, ``frames``
+        itself otherwise (byte-inert off the selected subset).  The
+        noise scale comes from a bounded strided decode of the frames —
+        the reader thread never pays a full-chunk unpack.
+        """
+        if not self._bound or not self.selects(chunk):
+            return frames
+        from ..io.lowbit import sample_codes
+
+        mask = (1 << nbits) - 1
+        frames = np.asarray(frames)
+        nsamp = frames.shape[0]
+        rng = np.random.default_rng(self._rng_key(chunk, 1))
+        t0 = int(rng.integers(0, nsamp))
+        # per-channel noise scale from a strided row subsample, decoded
+        # once (a few thousand frames regardless of chunk size)
+        sub = sample_codes(frames, nbits, nchan)  # (nchan_file, k)
+        if band_descending:
+            sub = sub[::-1]  # ascending-channel view, like the shifts
+        std = sub.astype(np.float64).std(axis=1)
+        std = np.where(std > 0, std, std[std > 0].mean()
+                       if np.any(std > 0) else 1.0)
+        amp = self.snr * std / np.sqrt(nchan * self._width)
+        cols = (t0 + self._shifts[:, None]
+                + np.arange(self._width)[None, :]) % nsamp
+        out = frames.copy()
+        for c in range(nchan):
+            fc = (nchan - 1 - c) if band_descending else c
+            bi = (fc * nbits) // 8
+            sh = (fc * nbits) % 8
+            # adjacent channels share bytes at <8 bits: the per-channel
+            # loop keeps the read-modify-write race-free (vectorised
+            # fancy indexing would silently drop duplicate-byte updates)
+            b = out[cols[c], bi]
+            code = (b >> sh) & mask
+            bumped = np.clip(np.rint(code.astype(np.float64) + amp[c]),
+                             0, mask).astype(np.uint8)
+            out[cols[c], bi] = ((b & np.uint8(0xFF ^ (mask << sh)))
+                                | (bumped << np.uint8(sh)))
+        with self._lock:
+            self._pending[int(chunk)] = {
+                "chunk": int(chunk), "t0": t0, "nsamp": int(nsamp),
+                "dm": self.dm, "snr": self.snr, "width": self._width}
+        _metrics.counter("putpu_canary_packed_injections_total",
+                         **self._labels).inc()
+        return out
+
     # -- matching (main thread, after the search) ----------------------------
 
     def _tolerance(self, trial_dms):

@@ -66,6 +66,9 @@ METRIC_NAMES = {
         "canary pulses observed by the search",
     "putpu_canary_missed_total":
         "canary pulses the search failed to recover",
+    "putpu_canary_packed_injections_total":
+        "canary pulses quantized and re-packed into packed low-bit "
+        "chunks",
     "putpu_canary_period_skips_total":
         "folded period-search stages skipped on injected chunks",
     "putpu_canary_promoted_hits_total":
@@ -147,6 +150,10 @@ METRIC_NAMES = {
         "service jobs reaching a terminal state (labelled by status)",
     "putpu_jobs_submitted_total":
         "jobs accepted by the survey service",
+    "putpu_lowbit_bytes_saved_total":
+        "link bytes the packed low-bit upload saved vs float32",
+    "putpu_lowbit_packed_chunks_total":
+        "chunks searched from raw packed bytes (device unpack)",
     "putpu_multibeam_batches_total":
         "batched multi-beam dispatches (one device program serving N "
         "beam-chunks)",

@@ -134,7 +134,11 @@ def dedisperse_block_roll_jax(data, offsets):
     ``take_along_axis`` gather to scalar loads — measured 14x slower
     than this formulation at a 16-trial x 256-chan x 65k-sample hybrid
     rescore bucket (6.3 s vs 0.5 s; the round-6 streaming-budget work
-    caught the rescore stage dominating the CPU survey stream).  On TPU
+    caught the rescore stage dominating the CPU survey stream).
+    Integer inputs (the packed low-bit path's int16/int32 codes,
+    ISSUE 11) accumulate in their own dtype — the scan carry inherits
+    it — giving the same exact sums as the gather formulation's
+    explicit integer reduction.  On TPU
     the batched gather vectorises well and the Pallas kernel owns the
     fast path anyway, so the gather formulation stays (see
     :func:`dedisperse_block_jax`).  Float32 channel sums associate
@@ -207,6 +211,16 @@ def dedisperse_block_jax(data, offsets, formulation=None):
     # idx[d, c, t] = (t + off[d, c]) mod T
     idx = (tidx[None, None, :] + offsets[:, :, None]) % t
     gathered = jnp.take_along_axis(data[None, :, :], idx, axis=2)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        # integer sweep accumulation (packed low-bit path, ISSUE 11):
+        # the caller unpacked to an accum_dtype that provably holds the
+        # full-channel sum, so the accumulation stays in that dtype —
+        # an int16 plane halves the sweep's HBM traffic vs float32, and
+        # scoring's float32 view of the exact integer sums is
+        # bit-identical to the float-accumulated reference (io/lowbit.
+        # accum_dtype states the bound).  The explicit dtype pins the
+        # reduction against numpy-style silent promotion to int64.
+        return gathered.sum(axis=1, dtype=data.dtype)
     return gathered.sum(axis=1)
 
 

@@ -81,6 +81,12 @@ def score_profiles(plane, xp=np):
     assert SEARCH_WINDOWS == (1, 2, 4, 8), \
         "the incremental pyramid assumes doubling windows"
     plane = xp.asarray(plane)
+    if not xp.issubdtype(plane.dtype, xp.floating):
+        # integer-accumulated sweep plane (packed low-bit path): every
+        # value is an exact integer below 2^24 (io/lowbit.accum_dtype's
+        # bound), so this float32 view is exact and the scores are
+        # bit-identical to a float32-accumulated plane's
+        plane = plane.astype(xp.float32)
     x = plane - plane.mean(axis=1, keepdims=True)
     maxvalues = x.max(axis=1)
     stds = x.std(axis=1)
@@ -329,11 +335,23 @@ def search_kernel_fn(data, offset_blocks, capture_plane=False,
 
 
 @functools.lru_cache(maxsize=32)
-def _jax_search_kernel(capture_plane, chan_block, formulation=None):
+def _jax_search_kernel(capture_plane, chan_block, formulation=None,
+                       packed=None):
+    """The direct-sweep program.  ``packed`` (a
+    :meth:`~pulsarutils_tpu.io.lowbit.PackedFrames.meta` tuple) makes
+    ``data`` the RAW packed uint8 frames: the bit-unpack runs inside
+    this jit, so the host->device link carries 1/8-1/16th the bytes and
+    — when the meta names an integer dtype — the sweep accumulates in
+    int16/int32 (exact; converted to float32 only at scoring)."""
     import jax
+    import jax.numpy as jnp
 
     @jax.jit
     def kernel(data, offset_blocks):
+        if packed is not None:
+            from ..io.lowbit import unpack_from_meta
+
+            data = unpack_from_meta(data, packed, jnp)
         return search_kernel_fn(data, offset_blocks,
                                 capture_plane=capture_plane,
                                 chan_block=chan_block,
@@ -526,8 +544,14 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
     import jax
     import jax.numpy as jnp
 
-    nchan, nsamples = np.shape(data)
+    from ..io.lowbit import PackedFrames, accum_dtype
+
+    packed = data if isinstance(data, PackedFrames) else None
+    nchan, nsamples = np.shape(data)  # PackedFrames reports its logical shape
     ndm = len(trial_dms)
+    if packed is not None and dtype not in (None, jnp.float32):
+        raise ValueError("packed low-bit input unpacks to float32 (or an "
+                         "exact integer accumulator); pass dtype=None")
 
     if kernel == "fourier":
         from .fourier import search_fourier
@@ -537,6 +561,10 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
                              "kernel='pallas'/'auto' or backend='numpy'")
         if dtype not in (None, jnp.float32):
             raise ValueError("kernel='fourier' supports float32 only")
+        if packed is not None:
+            # FDD wants the float block: packed upload + cached device
+            # unpack (the link still carries the packed bytes)
+            data = packed.to_device()
         # before the integer-offset table: the FDD uses un-rounded delays
         # (and data passes through untouched — converting a
         # device-resident chunk would bounce it over the slow link)
@@ -572,11 +600,24 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
         if dtype not in (None, jnp.float32):
             raise ValueError("kernel='pallas' supports float32 only; use "
                              "kernel='gather' for other dtypes")
+        if packed is not None:
+            data = packed.to_device()  # packed upload, unpack on HBM
         data = jnp.asarray(data, dtype=jnp.float32)
         return _search_jax_pallas(data, offsets, capture_plane, dm_block,
                                   chan_block)
+    packed_meta = None
+    if packed is not None:
+        # in-jit unpack for the traceable formulations: the RAW bytes
+        # are the program's operand.  Integer accumulation only when
+        # the plane never leaves the program (capture consumers expect
+        # a float plane) and the exactness bound holds.
+        acc = (None if capture_plane
+               else accum_dtype(packed.nbits, nchan)) or "float32"
+        packed_meta = packed.meta(acc)
+        data = packed.frames
     dtype = dtype or jnp.float32
-    data = jnp.asarray(data, dtype=dtype)
+    data = (jnp.asarray(data) if packed_meta is not None
+            else jnp.asarray(data, dtype=dtype))
 
     if dm_block is None:
         dm_block = max(1, min(ndm, 32))
@@ -589,7 +630,8 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
     # and never reproduce PR 1's 14x) — pre-tuner "auto" callers are
     # unaffected because the static fallback names the formulation the
     # old backend switch picked ("roll" on CPU, the gather elsewhere)
-    gather_kernel = _jax_search_kernel(capture_plane, chan_block, kernel)
+    gather_kernel = _jax_search_kernel(capture_plane, chan_block, kernel,
+                                       packed_meta)
     roof = roofline.begin()  # wall spans dispatch -> readback completion
     with budget_bucket("search/dispatch"):
         offs_dev = jnp.asarray(offset_blocks)  # attributed, not hoisted
@@ -1490,6 +1532,20 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
     best-window maximum — arrival time within the chunk) — plus the
     ``(ndm, nsamples)`` plane if ``show``/``capture_plane``.
     """
+    from ..io.lowbit import PackedFrames
+
+    if isinstance(data, PackedFrames):
+        # packed low-bit input (ISSUE 11).  The traceable direct-sweep
+        # formulations unpack INSIDE their jit (handled in _search_jax);
+        # every other consumer gets the decode it can use while the
+        # link still carries only the packed bytes: a cached device
+        # unpack program for the jax tree/hybrid kernels, the C++/numpy
+        # host decode for the reference backend.
+        if backend == "numpy":
+            data = data.to_host()
+        elif kernel in ("fdmt", "hybrid"):
+            data = data.to_device()
+
     nchan = data.shape[0]
     if capture_plane is None:
         capture_plane = bool(show)

@@ -129,6 +129,16 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     import jax
     import jax.numpy as jnp
 
+    from ..io.lowbit import PackedFrames
+
+    if isinstance(data, PackedFrames):
+        # packed low-bit chunk (ISSUE 11): upload the RAW bytes and
+        # decode through the cached device-unpack program — the chan
+        # sharding below cannot split packed frames (byte boundaries
+        # straddle channel shards), so the unpack is its own dispatch
+        # and the sharded sweep consumes the HBM-resident float block;
+        # the link still carries only the packed bytes
+        data = data.to_device()
     dtype = dtype or jnp.float32
     nchan, nsamples = np.shape(data)
     if trial_dms is None:

@@ -111,7 +111,8 @@ def _stacked_tables(plans, t_tile):
 @counted_plan_cache("_build_sharded_fdmt", maxsize=PLAN_CACHE_SIZE)
 def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
                         use_pallas, interpret, plan_key, t_orig,
-                        with_cert=False, with_plane=False):
+                        with_cert=False, with_plane=False,
+                        packed_meta=None):
     """Compile the SPMD transform+score program for one mesh/geometry.
 
     ``plan_key`` carries the static per-iteration bounds (k_tiles,
@@ -121,6 +122,11 @@ def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
     ``with_plane`` additionally emits the final transform state — the
     dedispersed plane, DM-sharded ``P(axis, None)`` and device-resident
     (the mesh plane-products path, :mod:`.sharded_plane`).
+    ``packed_meta`` (a :meth:`~pulsarutils_tpu.io.lowbit.PackedFrames.
+    meta` tuple) makes ``data`` the RAW packed ``(T, bytes_per_frame)``
+    uint8 frames, replicated like the float block was: each device's
+    shard_map body starts with the bit-unpack, so the host->device
+    link carries 1/8-1/16th the bytes (ISSUE 11).
     """
     import jax
     import jax.numpy as jnp
@@ -132,8 +138,14 @@ def _build_sharded_fdmt(mesh, axis, nchan, nchan_padded, t, t_tile,
     iter_meta = plan_key  # tuple of (k_tiles, k_tiles_h, rows_max)
 
     def local_fn(data, *tables):
-        # data (nchan, T) replicated; tables: 4 arrays per iteration,
-        # each (1, rows_max) — this device's merge schedule
+        # data (nchan, T) replicated — or the raw packed frames,
+        # unpacked here INSIDE the one shard_map program; tables: 4
+        # arrays per iteration, each (1, rows_max) — this device's
+        # merge schedule
+        if packed_meta is not None:
+            from ..io.lowbit import unpack_from_meta
+
+            data = unpack_from_meta(data, packed_meta, jnp)
         state = data
         if nchan < nchan_padded:
             state = jnp.concatenate(
@@ -182,6 +194,11 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     testing the traced-table kernel path) or XLA (False) merge; default
     auto: Pallas on TPU.
 
+    ``data`` may be a :class:`~pulsarutils_tpu.io.lowbit.PackedFrames`
+    (ISSUE 11): the raw 1/2/4-bit bytes ship to the devices and each
+    shard_map body unpacks them in-program — 1/8-1/16th the link
+    traffic, scores byte-identical to the float-block run.
+
     Returns a :class:`~pulsarutils_tpu.utils.table.ResultTable` with the
     usual ``DM, max, std, snr, rebin, peak`` columns over the full grid.
     With ``capture_plane`` returns ``(table, plane)`` where ``plane`` is
@@ -193,9 +210,11 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     import jax
     import jax.numpy as jnp
 
+    from ..io.lowbit import PackedFrames
     from ..ops.search import unstack_scores
 
-    nchan, t = np.shape(data)
+    packed = data if isinstance(data, PackedFrames) else None
+    nchan, t = np.shape(data)  # PackedFrames reports its logical shape
     n_dev = mesh.shape[axis]
     trial_dms, n_lo, n_hi = fdmt_trial_dms(nchan, dmmin, dmmax, start_freq,
                                            bandwidth, sample_time)
@@ -204,7 +223,11 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     interpret = jax.default_backend() != "tpu"
-    data = jnp.asarray(data, jnp.float32)
+    packed_meta = packed.meta() if packed is not None else None
+    # packed input: the RAW bytes are the program operand — the unpack
+    # runs inside the shard_map body (_build_sharded_fdmt)
+    data = (jnp.asarray(packed.frames) if packed is not None
+            else jnp.asarray(data, jnp.float32))
     t_run = t
     t_tile = _pick_fdmt_tile(t)
     if use_pallas and t_tile == 0:
@@ -213,7 +236,13 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
         # scalarise on TPU, so padding to a tile multiple and slicing
         # the scores back is far cheaper than falling off Pallas
         t_run = -(-t // 1024) * 1024
-        data = jnp.pad(data, ((0, 0), (0, t_run - t)))
+        if packed is not None:
+            # frames are time-major: pad whole zero FRAMES — a zero
+            # byte decodes to zero codes, so the unpacked pad equals
+            # the float path's zero-sample pad exactly
+            data = jnp.pad(data, ((0, t_run - t), (0, 0)))
+        else:
+            data = jnp.pad(data, ((0, 0), (0, t_run - t)))
         t_tile = _pick_fdmt_tile(t_run)
     elif t_tile == 0:
         t_tile = 1024  # unused by the XLA merge path
@@ -226,7 +255,8 @@ def sharded_fdmt_search(data, dmmin, dmmax, start_freq, bandwidth,
 
     fn = _build_sharded_fdmt(mesh, axis, nchan, plans[0].nchan_padded,
                              t_run, t_tile, use_pallas, interpret,
-                             plan_key, t, with_cert, capture_plane)
+                             plan_key, t, with_cert, capture_plane,
+                             packed_meta)
     flat = []
     for it in tables:
         flat += [jnp.asarray(it[k]) for k in
@@ -310,7 +340,7 @@ def _plan_offsets(nchan, dmmin, dmmax, start_freq, bandwidth, sample_time,
 def _build_fused_sharded_hybrid(mesh, nchan, nchan_padded, t, t_tile,
                                 use_pallas, interpret, plan_key, ndm_plan,
                                 bucket, bucket2, rescore_kernel, chan_block,
-                                max_off, nchan_rs):
+                                max_off, nchan_rs, packed_meta=None):
     """ONE ``shard_map`` program for the mesh hybrid's first round:
 
     DM-sliced coarse FDMT (each dm shard runs its delay-range-pruned
@@ -366,6 +396,15 @@ def _build_fused_sharded_hybrid(mesh, nchan, nchan_padded, t, t_tile,
     c_loc = nchan_rs // chan_size
 
     def local_fn(data, idx_map, offsets_rs, cert_params, roll_k, *tables):
+        # packed low-bit input (ISSUE 11): the operand is the RAW
+        # (T, bytes_per_frame) uint8 frames and the bit-unpack is the
+        # first op of this ONE shard_map program — coarse transform,
+        # seed/need rescore and packing all read the unpacked block
+        # from HBM while the link only ever carried the packed bytes
+        if packed_meta is not None:
+            from ..io.lowbit import unpack_from_meta
+
+            data = unpack_from_meta(data, packed_meta, jnp)
         # ---- coarse: this dm shard's delay-sliced transform (chan
         # replicated) — identical math to _build_sharded_fdmt.local_fn
         state = data
@@ -496,6 +535,14 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     the cert machinery) and a certificate slack derived from a target
     miss probability (:func:`~pulsarutils_tpu.ops.certify.cert_slack_for_miss_p`).
 
+    ``data`` may be a :class:`~pulsarutils_tpu.io.lowbit.PackedFrames`
+    (ISSUE 11): the fused program's operand is then the raw 1/2/4-bit
+    bytes, unpacked inside the one ``shard_map`` dispatch — 1/8-1/16th
+    the link traffic; the escape-hatch rescore decodes lazily through a
+    cached device program, so certified / fused-converged chunks never
+    pay the float materialisation.  Results are byte-identical to the
+    host-unpacked run (``tests/test_lowbit_e2e.py``).
+
     ``fused`` (round 6): ``None`` (default) runs the first round —
     coarse FDMT + seed selection + exact seed/need rescore — as ONE
     ``shard_map`` dispatch (:func:`_build_fused_sharded_hybrid`)
@@ -532,15 +579,33 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     )
     from .sharded import sharded_dedispersion_search
 
-    nchan, nsamples = np.shape(data)
+    from ..io.lowbit import PackedFrames
+
+    pf = data if isinstance(data, PackedFrames) else None
+    nchan, nsamples = np.shape(data)  # PackedFrames reports logical shape
     dm_size = mesh.shape["dm"]
     chan_size = mesh.shape["chan"]
     # (the pad-free soundness guard lives in hybrid_certificate_gate,
     # shared verbatim with the single-device hybrid)
     # ONE host->device transfer: the coarse stage and every rescore call
     # reuse the same device-resident array (sharded_dedispersion_search
-    # passes aligned device inputs through untouched)
-    data = jnp.asarray(data, jnp.float32)
+    # passes aligned device inputs through untouched).  Packed low-bit
+    # input (ISSUE 11): the RAW bytes are the transfer; the fused
+    # program unpacks them in its own shard_map body, and the float
+    # view for the (rare) escape-hatch rescore is decoded lazily by a
+    # cached device program — a certified or fused-converged chunk
+    # never materialises it.
+    if pf is not None:
+        raw_dev = jnp.asarray(pf.frames)
+        data = None
+    else:
+        data = jnp.asarray(data, jnp.float32)
+
+    def _float_data():
+        nonlocal data
+        if data is None:
+            data = pf.to_device()
+        return data
 
     # chunk-geometry plan + offsets: ONE cached host computation, sliced
     # per rescore bucket (was re-derived inside every bucket call)
@@ -569,13 +634,22 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     offsets_raw, _ = pad_to_multiple(offsets_full, 1, chan_size,
                                      mode="constant")
     nchan_rs = offsets_raw.shape[1]
-    if nchan_rs > nchan:
-        # device-side pad: a np.pad here would bounce the (possibly
-        # multi-GB, device-resident) chunk through the host on every
-        # search (code-review r7)
-        data_rs = jnp.pad(data, ((0, nchan_rs - nchan), (0, 0)))
-    else:
-        data_rs = data
+    _rs_cache = {}
+
+    def _data_rs():
+        """Chan-aligned float chunk for the escape-hatch rescore, built
+        lazily: the fused program rescoring in-dispatch (the common
+        case) and the certified chunk never pay it — on the packed path
+        that also skips the whole device decode.  Device-side pad: a
+        np.pad here would bounce the (possibly multi-GB,
+        device-resident) chunk through the host on every search
+        (code-review r7)."""
+        if "v" not in _rs_cache:
+            d = _float_data() if pf is not None else data
+            _rs_cache["v"] = (jnp.pad(d, ((0, nchan_rs - nchan), (0, 0)))
+                              if nchan_rs > nchan else d)
+        return _rs_cache["v"]
+
     roll_k = 0
     rescore_max_off = None
     offsets_rs = offsets_raw  # the fused kernel's operand
@@ -653,7 +727,8 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
             mesh, nchan, plans[0].nchan_padded, nsamples, t_tile,
             use_pallas, interpret, plan_key, ndm, bucket, bucket2,
             rescore_kernel, chan_block,
-            0 if rescore_max_off is None else rescore_max_off, nchan_rs)
+            0 if rescore_max_off is None else rescore_max_off, nchan_rs,
+            pf.meta() if pf is not None else None)
         flat = []
         for it in tables:
             flat += [jnp.asarray(it[k]) for k in
@@ -662,8 +737,10 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
 
         roof = roofline.begin()
         with budget_bucket("search/fused"):
-            # operand conversions stay inside the bucket (attributed)
-            fused_args = (data, jnp.asarray(idx_map),
+            # operand conversions stay inside the bucket (attributed);
+            # on the packed path the operand IS the raw packed bytes
+            fused_args = (raw_dev if pf is not None else data,
+                          jnp.asarray(idx_map),
                           jnp.asarray(offsets_rs), jnp.asarray(cert_params),
                           jnp.int32(roll_k), *flat)
             packed = np.asarray(kernel_fn(*fused_args))
@@ -679,8 +756,12 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     else:
         # ---- two-stage composition (plane capture / certificate mode /
         # forced A/B baseline): coarse program, scores mapped host-side
-        coarse_out = sharded_fdmt_search(data, dmmin, dmmax, start_freq,
-                                         bandwidth, sample_time, mesh,
+        # (a packed chunk rides through as raw bytes — the coarse
+        # shard_map program unpacks in-body)
+        coarse_out = sharded_fdmt_search(pf if pf is not None
+                                         else data, dmmin, dmmax,
+                                         start_freq, bandwidth,
+                                         sample_time, mesh,
                                          axis="dm", with_cert=True,
                                          capture_plane=capture_plane)
         t_coarse, plane = (coarse_out if capture_plane
@@ -722,7 +803,7 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         budget_count("rescore_rows", len(rows))
         for blk, padded in iter_rescore_buckets(rows):
             t_ex = sharded_dedispersion_search(
-                data_rs, dmmin, dmmax, start_freq, bandwidth, sample_time,
+                _data_rs(), dmmin, dmmax, start_freq, bandwidth, sample_time,
                 mesh=mesh, trial_dms=trial_dms[padded],
                 offsets=offsets_raw[padded],
                 # the hatch must rescore with the SAME per-shard kernel

@@ -31,6 +31,7 @@ import numpy as np
 from ..faults import inject as fault_inject
 from ..faults.policy import (DispatchPolicy, QuarantineManifest,
                              call_with_deadline, gate_chunk,
+                             gate_chunk_lowbit, gate_chunk_packed,
                              resolve_integrity_policy)
 from ..io.candidates import CandidateStore, config_fingerprint
 from ..io.sigproc import FilterbankReader
@@ -461,8 +462,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
       statistics or crashing; sub-threshold NaN chunks are sanitized
       (non-finite values imputed, counted) under ``"sanitize"``.  The
       gate runs on the reader thread (overlapped, not on the chunk's
-      serial critical path) and is skipped on the packed low-bit fast
-      path (integer samples cannot hold NaN/Inf);
+      serial critical path); low-bit (1/2/4-bit) chunks — packed fast
+      path or host-decoded — are gated in the CODE domain instead
+      (rail/zero/dead-channel fractions off the raw packed bytes, with
+      thresholds rescaled onto the quantization floor, round 11 — the
+      float gate used to skip them entirely, leaving low-bit runs
+      health-blind);
     * persist failures retry ``persist_retries`` times with exponential
       ``persist_backoff`` and then **dead-letter** the chunk into the
       quarantine manifest instead of failing the whole run on one bad
@@ -492,8 +497,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
       outranks a genuine weaker pulse in the same chunk, that pulse is
       promoted (persisted with the canary rows masked out of its
       table) so the science candidate set matches the canary-off run;
-      unsupported (and auto-disabled, with a warning) on the packed
-      low-bit fast path;
+      on the packed low-bit fast path the bump is quantized into the
+      low-bit codes and re-packed on the reader thread (round 11), so
+      recall is measured there too — the old auto-disable is gone;
     * ``health`` accepts a caller-owned
       :class:`~pulsarutils_tpu.obs.health.HealthEngine` (the chaos
       drill passes one); with ``http_port`` set and no engine given,
@@ -654,20 +660,15 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                    if (backend == "jax" and reader.nifs == 1
                        and reader._nbits in (1, 2, 4)) else 0)
     if canary is not None:
-        if packed_bits:
-            # the packed fast path uploads RAW bytes and unpacks on
-            # device: a host-side float injection has no seam there
-            logger.warning(
-                "canary injection is not supported on the packed "
-                "low-bit fast path (raw bytes upload, device unpack): "
-                "canaries DISABLED for this run — recall will not be "
-                "measured")
-            canary = None
-        else:
-            canary.bind(nchan=header["nchans"], start_freq=start_freq,
-                        bandwidth=bandwidth, tsamp=sample_time,
-                        dmmin=dmmin, dmmax=dmmax,
-                        resample=plan.resample)
+        # the packed fast path injects too (round 11): the bump is
+        # quantized into the low-bit codes and re-packed on the reader
+        # thread (CanaryController.maybe_inject_packed), so the device
+        # signature is exact and recall is measured on packed runs —
+        # the old auto-disable seam is gone
+        canary.bind(nchan=header["nchans"], start_freq=start_freq,
+                    bandwidth=bandwidth, tsamp=sample_time,
+                    dmmin=dmmin, dmmax=dmmax,
+                    resample=plan.resample)
     device_clean = None
     if backend == "jax":
         import functools
@@ -822,7 +823,25 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     # the budget in microseconds and quarantine a chunk
                     # over a sub-second I/O blip (code-review r8)
                     time.sleep(0.1 * (2 ** attempt))
-            if not packed_bits:
+            if packed_bits:
+                # packed fast path (round 11): the canary bump is
+                # quantized into the low-bit codes and re-packed here —
+                # whatever unpacks these bytes (device jit, host
+                # fallback) sees an exact signature — and the
+                # code-domain integrity gate reads cheap shift/mask
+                # stats off the raw bytes (the float gate was skipped
+                # on quantized data since PR 4, leaving low-bit runs
+                # health-blind)
+                if canary is not None:
+                    block = canary.maybe_inject_packed(
+                        block, s, nbits=packed_bits,
+                        nchan=header["nchans"],
+                        band_descending=reader.band_descending)
+                if integrity is not None:
+                    block, gate_info = gate_chunk_packed(
+                        block, packed_bits, header["nchans"], integrity)
+                    return block, gate_info
+            else:
                 block = fault_inject.corrupt("corrupt", block, chunk=s)
                 if canary is not None:
                     # canary rides AFTER any armed fault corruption: it
@@ -830,15 +849,17 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     # will see, so an RFI storm that masks real pulses
                     # masks canaries too — which is the point
                     block = canary.maybe_inject(block, s)
-                # the gate only makes sense for full-rate samples:
-                # quantized low-bit data (1/2/4-bit — packed fast path
-                # OR host-decoded) cannot hold NaN/Inf, and its
-                # saturation/zero fractions sit at the quantization
-                # levels by construction (a 1-bit chunk is ~50% "at the
-                # rail"), so gating it would false-quarantine healthy
-                # chunks (code-review r8)
                 if integrity is not None \
-                        and reader._nbits not in (1, 2, 4):
+                        and reader._nbits in (1, 2, 4):
+                    # host-decoded low-bit chunk (numpy backend): the
+                    # float-domain gate is meaningless on quantized
+                    # codes (a healthy 1-bit chunk is ~50% at the
+                    # rail, code-review r8) — the CODE-domain rule
+                    # applies instead
+                    block, gate_info = gate_chunk_lowbit(
+                        np.asarray(block), reader._nbits, integrity)
+                    return block, gate_info
+                if integrity is not None:
                     # gated HERE, on the reader thread: the stats pass
                     # overlaps the previous chunk's device work instead
                     # of sitting on the chunk's serial critical path
@@ -1030,6 +1051,16 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
 
             src = None
             if device_clean is not None:
+                if packed_bits:
+                    # the acceptance metric of the packed path: chunks
+                    # served from raw bytes, and the link bytes the
+                    # float32 upload would have cost on top
+                    obs_metrics.counter(
+                        "putpu_lowbit_packed_chunks_total").inc()
+                    obs_metrics.counter(
+                        "putpu_lowbit_bytes_saved_total").inc(
+                        int(header["nchans"] * array.shape[0] * 4
+                            - array.nbytes))
                 with with_timer("upload_wait"):
                     try:
                         import jax as _jax

@@ -59,10 +59,12 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: value drops to 0.0 when any per-beam candidate table diverges from
 #: the sequential arm; 14: the 2-worker fleet-vs-single-process A/B —
 #: its value drops to 0.0 when any per-file ledger or candidate byte
-#: diverges or the fleet fails to finish; all seven run in
-#: tier-1-scale time)
+#: diverges or the fleet fails to finish; 15: the packed-low-bit
+#: vs host-unpack streaming A/B — its value drops to 0.0 when any
+#: per-chunk table byte diverges or the uploaded-bytes ratio falls
+#: below 8x; all eight run in tier-1-scale time)
 DEFAULT_BASELINE = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
-DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14)
+DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15)
 
 #: the committed tune-cache artifact the gate version-checks (the
 #: snapshot-schema rule of PR 5, applied to tuner measurements: a
@@ -93,9 +95,13 @@ DEFAULT_TUNE_ARTIFACT = os.path.join(REPO, "TUNE_cpu.json")
 #: vs 2-thread fleet on one CPU core): the gated signal is the forced
 #: 0.0 on a ledger/candidate byte divergence or an unfinished survey,
 #: so it takes the wall-clock bound too.
+#: Config 15 is one more quotient-of-walls (host-unpack vs packed
+#: streaming on CPU, where "upload" is a memcpy): its gated signal is
+#: the forced 0.0 on a per-chunk table byte divergence or a
+#: bytes-uploaded ratio below 8x, so the wall-clock bound applies.
 #: Config 10 stays TIGHT: canary recall is deterministic, not jittery.
 DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75, 13: 0.75,
-                          14: 0.75}
+                          14: 0.75, 15: 0.75}
 
 
 def run_suite(configs, preset, out_path):
