@@ -1087,11 +1087,87 @@ def config15(quick):
           "hits": {"host": len(hits_h), "packed": len(hits_p)}})
 
 
+def config16(quick):
+    """Constrained-memory A/B (ISSUE 12): the chaos-drill survey
+    searched twice through ``search_by_chunks`` —
+
+    * **unconstrained arm** — the fault-free baseline;
+    * **degraded arm** — a ``kind="oom"`` fault injected at the first
+      chunk's dispatch (a real ``XlaRuntimeError``-shaped
+      ``RESOURCE_EXHAUSTED``), forcing one degradation-ladder descent;
+      every chunk from there on dispatches in split trial passes.
+
+    ``value`` is the unconstrained/degraded wall ratio — FORCED to 0.0,
+    far past any tolerance, when any candidate or ledger byte diverges
+    between the arms, when no ladder descent actually fired, or when
+    the degraded run's health verdict fails to recover to OK (the
+    memory_pressure condition must decay on the clean chunks behind
+    the injected one).
+    """
+    import shutil
+    import tempfile
+
+    drill = _load_tool("chaos_drill")
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+    from pulsarutils_tpu.obs.health import HealthEngine
+
+    base_dir = tempfile.mkdtemp(prefix="bench_oom_")
+    try:
+        path = os.path.join(base_dir, "survey.fil")
+        drill.make_survey_file(path)
+        from pulsarutils_tpu.pipeline.spectral_stats import get_bad_chans
+
+        get_bad_chans(path)  # warm the pre-scan cache outside both arms
+        # warm-up arm: compiles out of the timed region (both arms
+        # reuse the same interior-chunk executable)
+        drill.run_search(path, os.path.join(base_dir, "warm"))
+
+        t0 = time.perf_counter()
+        _, store = drill.run_search(path, os.path.join(base_dir, "clean"))
+        clean_wall = time.perf_counter() - t0
+        fingerprint = store.fingerprint
+        baseline = drill.snapshot_outputs(os.path.join(base_dir, "clean"),
+                                          fingerprint)
+
+        plan = FaultPlan([FaultSpec(site="dispatch", kind="oom",
+                                    chunks=(drill.NOISE_CHUNK,),
+                                    times=1)])
+        engine = HealthEngine()
+        t0 = time.perf_counter()
+        drill.run_search(path, os.path.join(base_dir, "degraded"),
+                         plan=plan, health=engine)
+        degraded_wall = time.perf_counter() - t0
+        fresh = drill.snapshot_outputs(os.path.join(base_dir, "degraded"),
+                                       fingerprint)
+        diffs = drill.diff_outputs(baseline, fresh)
+        descended = any(t["to"] in ("DEGRADED", "CRITICAL")
+                        for t in engine.transitions)
+        recovered = engine.verdict == "OK"
+        ok = (not diffs and bool(plan.fired()) and descended
+              and recovered)
+        if diffs:
+            log(f"config 16: degraded outputs diverge: {diffs}")
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+    emit({"config": 16, "metric": "constrained-memory A/B: injected "
+          "RESOURCE_EXHAUSTED forces a degradation-ladder descent on a "
+          f"{len(drill.CHUNKS)}-chunk survey",
+          "value": round(clean_wall / degraded_wall, 4) if ok else 0.0,
+          "unit": "x (unconstrained/degraded wall; 0 = byte divergence,"
+                  " no descent, or health not recovered)",
+          "byte_identical": not diffs,
+          "oom_fired": plan.fired(),
+          "ladder_descended": descended,
+          "health_recovered": recovered,
+          "clean_wall_s": round(clean_wall, 3),
+          "degraded_wall_s": round(degraded_wall, 3)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13, 14, 15])
+                                 13, 14, 15, 16])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -1120,7 +1196,7 @@ def main(argv=None):
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15}
+           15: config15, 16: config16}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

@@ -58,6 +58,14 @@ CONTAINMENT_SEAMS = {
     ("pipeline/search_pipeline.py", "_search_with_fallback"),
     ("pipeline/search_pipeline.py", "search_by_chunks"),
     ("faults/policy.py", "call_with_deadline"),  # watchdog-thread relay
+    # OOM degradation-ladder catch sites (ISSUE 12): each classifies
+    # with resilience.ladder.is_resource_exhausted and RE-RAISES
+    # everything that is not RESOURCE_EXHAUSTED (after the usual
+    # (ValueError, TypeError) re-raise) — jax errors share no base
+    # class, so the broad handler is the only way to catch the OOM
+    ("ops/search.py", "_search_jax"),
+    ("parallel/sharded_fdmt.py", "sharded_hybrid_search"),
+    ("beams/batcher.py", "BeamBatcher.search"),
     # one failed tenant batch marks its jobs FAILED; the service worker
     # thread must survive to run the next batch (jax errors share no
     # base class here either)

@@ -32,7 +32,7 @@ import numpy as np
 
 from ..ops.search import _offsets_for, block_offsets, search_kernel_fn
 from ..tuning.geometry import PLAN_CACHE_SIZE
-from ..utils.logging_utils import budget_bucket, budget_count
+from ..utils.logging_utils import budget_bucket, budget_count, logger
 from ..utils.table import ResultTable
 
 __all__ = ["BeamBatcher", "BeamGeometryError", "batched_search_kernel"]
@@ -341,6 +341,20 @@ class BeamBatcher:
             int(data.nbytes))
         return data
 
+    def max_batch(self, nsamples=None):
+        """The beam-batch width the memory budget admits for one
+        dispatch (``None`` = budget unknown, no cap) — the admission
+        number :class:`~pulsarutils_tpu.beams.service.SurveyService`
+        caps co-batches with, and the preflight bound :meth:`search`
+        splits against (ISSUE 12)."""
+        from ..resilience.memory_budget import max_beam_batch
+
+        return max_beam_batch(
+            self.nchan, int(nsamples or self.nsamples), self.ndm,
+            dm_block=self.dm_block, chan_block=self.chan_block,
+            formulation=self.kernel,
+            packed_nbits=self.packed_meta[0] if self.packed_meta else 0)
+
     def search(self, blocks):
         """Search one chunk epoch across all beams in ONE dispatch.
 
@@ -352,18 +366,59 @@ class BeamBatcher:
         ``dispatches`` + one ``readbacks`` count for the whole batch —
         that 2 vs ``2B`` trip count is the entire point (config 13
         gates it).
+
+        Resource exhaustion (ISSUE 12): a batch whose preflight
+        estimate exceeds measured headroom is split *before* dispatch,
+        and a dispatch that still raises ``RESOURCE_EXHAUSTED``
+        re-dispatches as two half-batches (the ladder's
+        ``halve_batch`` rung) — ``lax.map`` runs the identical
+        per-beam trace whatever the batch width, so the per-beam
+        tables are byte-identical to the unsplit dispatch (pinned in
+        ``tests/test_resilience.py`` for both formulations, packed and
+        float).  A single beam that OOMs has no smaller batch left and
+        the error propagates to the caller's ladder.
         """
+        from ..faults import inject as fault_inject
+        from ..resilience import ladder as _ladder
+
         raw_len = self._check(blocks)
+        searched = self._searched_len(raw_len)
+        cap = self.max_batch(searched)
+        if cap is not None and 1 <= cap < len(blocks):
+            # preflight split: the estimate says this co-batch cannot
+            # fit — shed batch width BEFORE compiling/dispatching
+            _ladder.count_split("preflight")
+            return (self.search(blocks[:cap])
+                    + self.search(blocks[cap:]))
         kernel = batched_search_kernel(self.chan_block, self.kernel,
                                        self.packed_meta, self.prep)
-        with budget_bucket("search/dispatch"):
-            offs_dev = self._offsets_dev(self._searched_len(raw_len))
-            data = self._stack(blocks)
-            out = kernel(data, offs_dev)
-            budget_count("dispatches")
-        with budget_bucket("search/readback"):
-            stacked = np.asarray(out)
-            budget_count("readbacks")
+        try:
+            fault_inject.fire("beams", chunk=None, batch=len(blocks))
+            with budget_bucket("search/dispatch"):
+                offs_dev = self._offsets_dev(searched)
+                data = self._stack(blocks)
+                out = kernel(data, offs_dev)
+                budget_count("dispatches")
+            with budget_bucket("search/readback"):
+                stacked = np.asarray(out)
+                budget_count("readbacks")
+        except (ValueError, TypeError):
+            raise  # deterministic configuration error, never OOM
+        except Exception as exc:  # jax errors share no base class
+            if len(blocks) <= 1 or not _ladder.is_resource_exhausted(exc):
+                raise
+            _ladder.oom_event("beam_batch")
+            _ladder.descend("halve_batch")
+            _ladder.count_split("ladder")
+            half = (len(blocks) + 1) // 2
+            logger.warning(
+                "batched beam dispatch (%d beams) hit "
+                "RESOURCE_EXHAUSTED (%r); re-dispatching as two "
+                "half-batches (%d + %d, per-beam tables "
+                "byte-identical)", len(blocks), exc, half,
+                len(blocks) - half)
+            return (self.search(blocks[:half])
+                    + self.search(blocks[half:]))
         return self._tables(stacked)
 
     def search_single(self, block):

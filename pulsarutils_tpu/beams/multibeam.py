@@ -153,6 +153,13 @@ def multibeam_search(fnames, dmmin=200, dmmax=800, *, snr_threshold=6.0,
     """
     if not fnames:
         raise ValueError("multibeam_search needs at least one filterbank")
+    from ..resilience import ladder as _resilience_ladder
+
+    # each batched survey session starts undegraded, exactly like the
+    # single-file drivers: a transient OOM in one tenant batch must not
+    # permanently degrade every later job of a long-lived service
+    # process (ISSUE 12; code-review r16)
+    _resilience_ladder.reset()
     readers, labels = open_beams(fnames)
     nbeams = len(readers)
     header = readers[0].header

@@ -288,11 +288,51 @@ class SurveyService:
         job.finished_at = time.time()
         _metrics.counter("putpu_jobs_finished_total", status=state).inc()
 
+    def _admission_cap(self, job):
+        """Beam count the device memory budget admits for one co-batch
+        of this job's geometry (``None`` = no budget known, no cap).
+
+        Pure host math off the header fields cached in the geometry
+        tag (no disk under the lock): the chunk plan the batched run
+        will use is re-derived from the same physics
+        (:func:`~pulsarutils_tpu.parallel.stream.plan_chunks`), the
+        trial count approximated by the plan's one-trial-per-delay-
+        sample rule, and the footprint estimator's
+        :func:`~pulsarutils_tpu.resilience.memory_budget.
+        max_beam_batch` caps the batch so co-tenants are never batched
+        into an OOM (ISSUE 12).
+        """
+        from ..resilience.memory_budget import (device_budget_bytes,
+                                                max_beam_batch)
+
+        budget = device_budget_bytes()
+        if budget is None:
+            return None
+        (nchans, tsamp, fch1, foff, _nifs, nbits), _ = job.geom_tag
+        spec = job.spec
+        edge = fch1 + foff * (nchans - 1)
+        fbottom = min(fch1, edge) - abs(foff) / 2
+        ftop = max(fch1, edge) + abs(foff) / 2
+        from ..parallel.stream import plan_chunks
+
+        plan = plan_chunks(0, tsamp, spec["dmmin"], spec["dmmax"],
+                           fbottom, ftop, foff,
+                           chunk_length=spec.get("chunk_length"),
+                           new_sample_time=spec.get("new_sample_time"))
+        t_eff = max(plan.step // plan.resample, 2)
+        return max_beam_batch(
+            nchans, t_eff, max(t_eff // 2, 1),
+            packed_nbits=nbits if nbits in (1, 2, 4) else 0,
+            budget=budget)
+
     def _pop_batch(self):
         """Pop the head job plus every queued job batchable with it:
         same geometry tag, same DM range and forwarded knobs (the chunk
         plan, trial grid and threshold must be shared for their chunks
-        to stack)."""
+        to stack).  Admission control (ISSUE 12): the co-batch is
+        capped at what the memory budget admits — excess jobs stay
+        queued (still accepted, batched at the capped size on a later
+        pop) instead of being co-batched into an OOM."""
         with self._lock:
             if not self._queue:
                 return []
@@ -312,6 +352,16 @@ class SurveyService:
                        for b in batch):
                     continue
                 batch.append(job_id)
+            cap = self._admission_cap(self._jobs[batch[0]]) if batch \
+                else None
+            if cap is not None and len(batch) > max(cap, 1):
+                _metrics.counter(
+                    "putpu_oom_admission_capped_total").inc()
+                logger.info(
+                    "admission control: %d-tenant co-batch capped at "
+                    "%d beam(s) by the memory budget; the rest stay "
+                    "queued", len(batch), max(cap, 1))
+                batch = batch[:max(cap, 1)]
             for job_id in batch:
                 self._queue.remove(job_id)
                 job = self._jobs[job_id]

@@ -17,11 +17,23 @@ site       seam                                                 kinds
                                                                 ``dead_channels``,
                                                                 ``zero_run``, ``saturate``,
                                                                 ``impulse`` (RFI storm)
-``dispatch``the per-chunk device search dispatch                ``error``, ``hang``
-``mesh``   the sharded multi-device route inside the dispatch   ``error``, ``hang``
+``dispatch``the per-chunk device search dispatch                ``error``, ``hang``, ``oom``
+``mesh``   the sharded multi-device route inside the dispatch   ``error``, ``hang``, ``oom``
+``beams``  ``BeamBatcher.search`` (the batched beam dispatch)   ``error``, ``oom``
+``host``   the numpy-fallback rung of the chunk ladder          ``oom``
 ``persist````CandidateStore.save_candidate``                    ``error``
 ``fleet``  ``FleetWorker._run_unit`` (per leased unit; ISSUE 9) ``error``, ``hang``
 ========== ==================================================== ==========================
+
+``kind="oom"`` (ISSUE 12) raises a *real* ``XlaRuntimeError``-shaped
+``RESOURCE_EXHAUSTED`` (jaxlib's own exception class where importable),
+so the resilience layer's classifier
+(:func:`~pulsarutils_tpu.resilience.ladder.is_resource_exhausted`) and
+its degradation ladder are exercised on exactly the failure production
+raises; at the ``host`` site it raises ``MemoryError`` instead — the
+ladder-floor (host memory) failure the ``oom_floor`` drill class
+quarantines.  ``times=`` distinguishes transient (ladder recovers,
+candidates byte-identical) from persistent (floor reached) pressure.
 
 The ``fleet`` site fires *inside the worker*, before a leased unit's
 ``search_by_chunks`` session starts — ``kind="hang"`` wedges a worker
@@ -75,6 +87,25 @@ _SITE_DEFAULT_EXC = {"read": "OSError", "persist": "OSError"}
 
 _CORRUPT_KINDS = ("nan", "inf", "dead_channels", "zero_run", "saturate",
                   "impulse")
+
+
+def _resource_exhausted_exc(site, chunk):
+    """An injected OOM shaped exactly like production's: jaxlib's own
+    ``XlaRuntimeError`` carrying the XLA ``RESOURCE_EXHAUSTED`` status
+    text (a local stand-in class of the same name on jax-free
+    checkouts), or ``MemoryError`` at the ``host`` site — the ladder
+    floor's failure mode."""
+    msg = (f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+           f"17179869184 bytes. "
+           f"(FAULTPLAN: injected {site} oom, chunk={chunk})")
+    if site == "host":
+        return MemoryError(msg)
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+    except ImportError:
+        class XlaRuntimeError(RuntimeError):
+            pass
+    return XlaRuntimeError(msg)
 
 
 @dataclasses.dataclass
@@ -145,9 +176,9 @@ class FaultPlan:
     # -- hooks (called via the module-level wrappers) ------------------------
 
     def fire(self, site, chunk=None, **ctx):
-        """Raise / hang for matching ``error``/``hang`` specs."""
+        """Raise / hang for matching ``error``/``hang``/``oom`` specs."""
         for spec in self.specs:
-            if spec.kind not in ("error", "hang") \
+            if spec.kind not in ("error", "hang", "oom") \
                     or not spec.matches(site, chunk):
                 continue
             if not self._claim(spec):
@@ -155,6 +186,8 @@ class FaultPlan:
             if spec.kind == "hang":
                 time.sleep(spec.seconds)
                 continue
+            if spec.kind == "oom":
+                raise _resource_exhausted_exc(site, chunk)
             exc_name = spec.exc or _SITE_DEFAULT_EXC.get(site,
                                                          "RuntimeError")
             exc_cls = _EXC_TYPES.get(exc_name, RuntimeError)

@@ -83,9 +83,9 @@ class _Lease:
 class _WorkerRec:
     __slots__ = ("id", "healthz_url", "verdict", "probe_failures",
                  "alive", "draining", "last_seen", "units_completed",
-                 "metrics", "registered_at")
+                 "metrics", "registered_at", "mem_budget")
 
-    def __init__(self, worker_id, healthz_url):
+    def __init__(self, worker_id, healthz_url, mem_budget=None):
         self.id = worker_id
         self.healthz_url = healthz_url
         self.verdict = "OK"
@@ -96,6 +96,9 @@ class _WorkerRec:
         self.units_completed = 0
         self.metrics = None       # last reported registry snapshot
         self.registered_at = time.time()
+        #: worker-reported device memory budget in bytes (ISSUE 12):
+        #: None = unreported, leases are sized by chunks_per_unit alone
+        self.mem_budget = mem_budget
 
     def doc(self, held):
         return {"worker": self.id, "healthz_url": self.healthz_url,
@@ -104,6 +107,7 @@ class _WorkerRec:
                 "probe_failures": self.probe_failures,
                 "last_seen": round(self.last_seen, 3),
                 "units_completed": self.units_completed,
+                "mem_budget_bytes": self.mem_budget,
                 "leases_held": held}
 
 
@@ -190,6 +194,8 @@ class FleetCoordinator:
         plan_config = dict(
             driver_defaults,
             **{k: v for k, v in config.items() if k in plan_params})
+        from ..resilience.memory_budget import estimate_chunk_bytes
+
         planned = []
         for fname in fnames:
             fname = os.path.abspath(str(fname))
@@ -197,10 +203,18 @@ class FleetCoordinator:
             done = self._read_ledger_done(sp["fingerprint"]) \
                 if self.resume else set()
             starts = [s for s in sp["chunk_starts"] if s not in done]
-            planned.append((fname, sp, starts))
+            # per-chunk footprint estimate (ISSUE 12): the number the
+            # coordinator sizes leases against for budget-reporting
+            # workers.  The trial count is the plan's one-trial-per-
+            # delay-sample rule (~half the post-resample chunk).
+            t_eff = max(sp["plan"].step // sp["plan"].resample, 2)
+            chunk_est = estimate_chunk_bytes(
+                sp["reader"].header["nchans"], t_eff,
+                max(t_eff // 2, 1))
+            planned.append((fname, sp, starts, chunk_est))
         ids = []
         with self._lock:
-            for fname, sp, starts in planned:
+            for fname, sp, starts, chunk_est in planned:
                 if fname in self._files \
                         and self._files[fname]["fingerprint"] \
                         != sp["fingerprint"]:
@@ -212,7 +226,8 @@ class FleetCoordinator:
                     "fingerprint": sp["fingerprint"], "config": config,
                     "root": sp["root"],
                     "chunks_total": len(sp["chunk_starts"]),
-                    "chunk_starts": list(sp["chunk_starts"])}
+                    "chunk_starts": list(sp["chunk_starts"]),
+                    "chunk_est_bytes": int(chunk_est)}
                 for i in range(0, len(starts), self.chunks_per_unit):
                     self._seq["unit"] += 1
                     unit = _Unit(f"u{self._seq['unit']}", fname,
@@ -294,6 +309,13 @@ class FleetCoordinator:
         if healthz is not None and not isinstance(healthz, str):
             raise ValueError("healthz_url must be a string or null")
         requested = doc.get("worker") if isinstance(doc, dict) else None
+        mem_budget = doc.get("mem_budget_bytes") \
+            if isinstance(doc, dict) else None
+        if mem_budget is not None:
+            if not isinstance(mem_budget, (int, float)) or mem_budget <= 0:
+                raise ValueError("mem_budget_bytes must be a positive "
+                                 "number or absent")
+            mem_budget = int(mem_budget)
         with self._lock:
             if self._closed:
                 raise ValueError("coordinator is shut down")
@@ -305,10 +327,13 @@ class FleetCoordinator:
             else:
                 self._seq["worker"] += 1
                 worker_id = f"w{self._seq['worker']}"
-            self._workers[worker_id] = _WorkerRec(worker_id, healthz)
+            self._workers[worker_id] = _WorkerRec(worker_id, healthz,
+                                                  mem_budget=mem_budget)
             self._update_gauges_locked()
-        logger.info("fleet: worker %s registered (healthz: %s)",
-                    worker_id, healthz or "none — TTL liveness only")
+        logger.info("fleet: worker %s registered (healthz: %s, "
+                    "mem budget: %s)", worker_id,
+                    healthz or "none — TTL liveness only",
+                    f"{mem_budget} B" if mem_budget else "unreported")
         return {"worker": worker_id, "lease_ttl_s": self.lease_ttl_s,
                 "poll_s": self.poll_s,
                 "protocol_version": protocol.PROTOCOL_VERSION}
@@ -369,6 +394,42 @@ class FleetCoordinator:
         if isinstance(health, dict) and "status" in health:
             worker.verdict = str(health["status"])
 
+    def _lease_limit_locked(self, worker, unit):
+        """Chunks-per-lease cap for a budget-reporting worker (ISSUE
+        12): sized so one lease's estimated footprint sum fits the
+        worker's reported device budget — a memory-constrained worker
+        searches slower (its ladder splits every dispatch), so it must
+        hold less work behind one lease TTL or expiry-stealing churns.
+        ``None`` = no budget reported / no estimate, size by
+        ``chunks_per_unit`` alone (the pre-ISSUE-12 behaviour)."""
+        if worker.mem_budget is None:
+            return None
+        per = self._files[unit.fname].get("chunk_est_bytes")
+        if not per:
+            return None
+        return max(int(worker.mem_budget // per), 1)
+
+    def _reshard_unit_locked(self, unit, keep_n, why):
+        """Split ``unit`` at ``keep_n`` chunks: the tail becomes a NEW
+        pending unit (front of the queue — re-sharded work is the
+        oldest work).  The caller still owns the head."""
+        tail = unit.chunks[keep_n:]
+        unit.chunks = unit.chunks[:keep_n]
+        self._seq["unit"] += 1
+        new = _Unit(f"u{self._seq['unit']}", unit.fname, tail)
+        # the tail INHERITS the attempt count: a re-shard must not mint
+        # a fresh max_attempts budget, or a unit no worker can fit
+        # would ping-pong through O(chunks x attempts) descendants
+        # instead of failing bounded (code-review r16)
+        new.attempts = unit.attempts
+        self._units[new.id] = new
+        self._pending.insert(0, new.id)
+        _metrics.counter("putpu_fleet_units_resharded_total").inc()
+        logger.info("fleet: unit %s re-sharded -> %s (%d chunks) + %s "
+                    "(%d chunks): %s", unit.id, unit.id,
+                    len(unit.chunks), new.id, len(tail), why)
+        return new
+
     def _grant_locked(self, worker, max_units, done_cache):
         granted = []
         busy = {}
@@ -389,6 +450,14 @@ class FleetCoordinator:
                 self._finish_unit_locked(unit)
                 continue
             unit.chunks = remaining
+            limit = self._lease_limit_locked(worker, unit)
+            if limit is not None and len(unit.chunks) > limit:
+                # size the lease to the worker's reported memory
+                # budget: grant the head, the tail re-queues as its
+                # own unit for any worker
+                self._reshard_unit_locked(
+                    unit, limit,
+                    f"sized to {worker.id}'s memory budget")
             unit.state = "leased"
             self._pending.remove(unit_id)
             self._seq["lease"] += 1
@@ -480,25 +549,40 @@ class FleetCoordinator:
         not started (its in-flight unit finishes normally and arrives
         as a ``complete``).  The worker is marked draining — no further
         grants — and every returned unit is ledger-checked back into
-        the queue."""
+        the queue.
+
+        ``reason="too_large"`` (ISSUE 12) is different: the worker's
+        preflight found the unit's footprint above its memory budget.
+        The worker is NOT marked draining (it wants other work), and
+        each returned unit is **re-sharded smaller** — split in half —
+        before requeueing, instead of landing verbatim on the next
+        victim; the attempt counter still burns so a unit no worker
+        can fit fails after ``max_attempts`` rather than ping-ponging
+        forever."""
         worker_id = str(protocol.require(doc, "worker", str, "release"))
         lease_ids = protocol.require(doc, "leases", list, "release")
         reason = str(doc.get("reason", "drain"))
+        too_large = reason == "too_large"
         done_cache = {}
         requeued = 0
         with self._lock:
             worker = self._workers.get(worker_id)
             if worker is not None:
                 worker.last_seen = time.time()
-                worker.draining = True
+                if not too_large:
+                    worker.draining = True
             for lease_id in lease_ids:
                 lease = self._leases.pop(str(lease_id), None)
                 if lease is None or lease.worker_id != worker_id:
                     continue
                 unit = self._units[lease.unit_id]
+                if too_large and len(unit.chunks) > 1:
+                    self._reshard_unit_locked(
+                        unit, (len(unit.chunks) + 1) // 2,
+                        f"too_large from {worker_id}")
                 requeued += bool(self._requeue_locked(
                     unit, done_cache, why=f"released ({reason})",
-                    count_attempt=False))
+                    count_attempt=too_large))
             self._update_gauges_locked()
         logger.info("fleet: %s released %d lease(s) (%s)", worker_id,
                     len(lease_ids), reason)

@@ -6,9 +6,11 @@ Four POST messages drive the whole fleet (served by the coordinator's
 ========== ============================================================
 message    body
 ========== ============================================================
-register   ``{"healthz_url": str|null, "worker": str|null}`` ->
+register   ``{"healthz_url": str|null, "worker": str|null,
+           "mem_budget_bytes": int|absent}`` ->
            ``{"worker": id, "lease_ttl_s", "poll_s",
-           "protocol_version"}``
+           "protocol_version"}`` — the memory budget (ISSUE 12) lets
+           the coordinator size leases to the worker's device
 lease      ``{"worker": id, "max_units": n, "health": {verdict
            doc}|absent}`` -> ``{"leases": [{
            "lease", "unit", "fname", "chunks", "config",
@@ -20,7 +22,12 @@ complete   ``{"worker", "lease", "unit", "error": str|null,
            "survey_done"}``
 release    ``{"worker", "leases": [ids], "reason": str}`` ->
            ``{"ok", "requeued": n}`` (graceful drain: unstarted
-           leases go back to the queue, the worker gets no more)
+           leases go back to the queue, the worker gets no more —
+           EXCEPT ``reason="too_large"`` (ISSUE 12), which does NOT
+           drain the worker: the unit's preflight estimate exceeded
+           its memory budget, so the coordinator re-shards the unit
+           smaller instead of requeueing it verbatim onto the next
+           victim)
 ========== ============================================================
 
 Design rules:
@@ -44,14 +51,29 @@ snapshot-schema rule, applied to the wire.
 
 from __future__ import annotations
 
+import http.client
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 
-__all__ = ["PROTOCOL_VERSION", "SEARCH_KEYS", "clean_search_config",
-           "get_json", "post_json", "require"]
+__all__ = ["PROTOCOL_VERSION", "SEARCH_KEYS", "TRANSIENT_WIRE_ERRORS",
+           "clean_search_config", "get_json", "post_json",
+           "post_json_retry", "require"]
 
 PROTOCOL_VERSION = 1
+
+#: transport failures worth one more try: a flaky connect, a reset
+#: socket, a timed-out read.  ``urllib.error.URLError`` wraps most
+#: transport errors (and is an ``OSError``); ``ConnectionError`` covers
+#: the raw ``ConnectionResetError``/``ConnectionRefusedError`` the
+#: http.client layer can leak mid-send; ``http.client.HTTPException``
+#: covers a torn response.  An HTTP *status* error is a ``ValueError``
+#: from :func:`post_json` and is never retried — the coordinator said
+#: no, and repeating the question would just repeat the answer.
+TRANSIENT_WIRE_ERRORS = (urllib.error.URLError, ConnectionError,
+                         TimeoutError, http.client.HTTPException)
 
 #: the ``search_by_chunks`` keyword arguments a lease may carry.  The
 #: science-affecting subset feeds the ledger fingerprint via
@@ -123,6 +145,37 @@ def post_json(url, doc, timeout=10.0):
         body = exc.read().decode(errors="replace")
         raise ValueError(f"{url} -> HTTP {exc.code}: {body.strip()}") \
             from exc
+
+
+def post_json_retry(url, doc, timeout=10.0, retries=3, backoff_s=0.2,
+                    jitter_s=0.1):
+    """:func:`post_json` with bounded retry on transient transport
+    failures (ISSUE 12 satellite: one flaky connect used to fail the
+    whole register/lease/complete/release call).
+
+    Exponential backoff with uniform jitter — a fleet of workers
+    retrying a briefly-unreachable coordinator must not reconverge in
+    lockstep.  Each retry counts ``putpu_fleet_wire_retries_total``;
+    the final failure propagates unchanged.  HTTP status errors
+    (``ValueError``) are never retried — they are protocol answers,
+    not transport weather.
+    """
+    from ..obs import metrics as _metrics
+
+    last = None
+    for attempt in range(max(int(retries), 0) + 1):
+        try:
+            return post_json(url, doc, timeout=timeout)
+        except ValueError:
+            raise  # HTTP status: the server answered; do not re-ask
+        except TRANSIENT_WIRE_ERRORS as exc:
+            last = exc
+            if attempt >= retries:
+                break
+            _metrics.counter("putpu_fleet_wire_retries_total").inc()
+            time.sleep(backoff_s * (2 ** attempt)
+                       + random.uniform(0.0, jitter_s))
+    raise last
 
 
 def get_json(url, timeout=5.0):

@@ -81,6 +81,7 @@ class FleetWorker:
         self._drain = threading.Event()
         self._server = None
         self._lease_ttl_s = None
+        self._floor_cache = {}   # fname -> minimum-footprint estimate
 
     # -- drain ----------------------------------------------------------------
 
@@ -98,8 +99,11 @@ class FleetWorker:
     # -- protocol client ------------------------------------------------------
 
     def _post(self, path, doc, timeout=30.0):
-        return protocol.post_json(self.coordinator_url + path, doc,
-                                  timeout=timeout)
+        # bounded retry + backoff/jitter on transient transport
+        # failures (ISSUE 12 satellite): one flaky connect no longer
+        # fails the register/lease/complete/release call outright
+        return protocol.post_json_retry(self.coordinator_url + path, doc,
+                                        timeout=timeout)
 
     def _register(self, retries=40, backoff_s=0.25):
         healthz_url = None
@@ -111,12 +115,19 @@ class FleetWorker:
                     host=self.http_host)
             healthz_url = (f"http://{self.http_host}:"
                            f"{self._server.port}/healthz")
+        from ..resilience.memory_budget import device_budget_bytes
+
         last = None
         for attempt in range(retries):
             try:
                 doc = self._post("/fleet/register",
                                  {"healthz_url": healthz_url,
-                                  "worker": self.requested_id})
+                                  "worker": self.requested_id,
+                                  # ISSUE 12: the coordinator sizes
+                                  # leases to this budget (absent =
+                                  # allocator reports no limit)
+                                  "mem_budget_bytes":
+                                      device_budget_bytes()})
                 break
             except OSError as exc:     # coordinator not up yet
                 last = exc
@@ -143,6 +154,57 @@ class FleetWorker:
                 "draining": self._drain.is_set()}
 
     # -- unit execution -------------------------------------------------------
+
+    def _unit_fits(self, lease):
+        """Preflight one lease against this worker's memory budget
+        (ISSUE 12 admission control): ``False`` when even the
+        degradation ladder's smallest device dispatch — the resident
+        chunk plus one trial block's working set — cannot fit, in which
+        case the unit goes back with ``reason="too_large"`` and the
+        coordinator re-shards it instead of this worker OOM-thrashing
+        through it.  Budget unknown (no allocator limit, no
+        ``PUTPU_MEM_LIMIT``) admits everything, the pre-ISSUE-12
+        behaviour.  The per-file floor estimate is cached — one header
+        read per file, not per lease."""
+        from ..resilience.memory_budget import (SAFETY_FRACTION,
+                                                device_budget_bytes,
+                                                estimate_direct)
+
+        budget = device_budget_bytes()
+        if budget is None:
+            return True
+        fname = lease["fname"]
+        floor = self._floor_cache.get(fname)
+        if floor is None:
+            try:
+                from ..io.sigproc import read_header
+                from ..parallel.stream import plan_chunks
+
+                header, _ = read_header(fname)
+                config = lease.get("config") or {}
+                plan = plan_chunks(
+                    header["nsamples"], header["tsamp"],
+                    config.get("dmmin", 200), config.get("dmmax", 800),
+                    header["fbottom"], header["ftop"], header["foff"],
+                    chunk_length=config.get("chunk_length"),
+                    new_sample_time=config.get("new_sample_time"))
+                t_eff = max(plan.step // plan.resample, 2)
+                est = estimate_direct(header["nchans"], t_eff,
+                                      max(t_eff // 2, 1), dm_passes=1)
+                # the ladder floor: the chunk must be resident plus one
+                # trial block's workspace — no split reduces it further
+                floor = est["operand"] + est["workspace"] \
+                    + est["scoring"]
+            except (OSError, ValueError, KeyError) as exc:
+                # an unreadable file is the UNIT's problem, not the
+                # admission gate's: admit it and let _run_unit report
+                # the real error to the coordinator
+                logger.warning("fleet worker %s: preflight of %s "
+                               "failed (%r); admitting the unit",
+                               self.worker_id, fname, exc)
+                floor = 0
+            self._floor_cache[fname] = floor
+        return floor <= SAFETY_FRACTION * budget
 
     def _run_unit(self, lease):
         """Run one leased unit through the hardened driver; returns the
@@ -292,6 +354,19 @@ class FleetWorker:
                         # coordinator re-leases them to live workers
                         self._release(leases[i:], "drain")
                         break
+                    if not self._unit_fits(lease):
+                        # admission preflight (ISSUE 12): this unit's
+                        # floor footprint exceeds our memory budget —
+                        # return it as too_large so the coordinator
+                        # re-shards it smaller instead of requeueing
+                        # it verbatim onto the next victim
+                        logger.warning(
+                            "fleet worker %s: unit %s too large for "
+                            "this worker's memory budget — releasing "
+                            "for re-shard", self.worker_id,
+                            lease["unit"])
+                        self._release([lease], "too_large")
+                        continue
                     error = self._run_unit(lease)
                     try:
                         self._complete(lease, error)

@@ -112,6 +112,7 @@ class HealthEngine:
         self._retraces = 0
         self._retries = 0
         self._quarantined = 0
+        self._oom_events = 0
 
     # -- condition plumbing --------------------------------------------------
 
@@ -164,14 +165,20 @@ class HealthEngine:
     def update(self, chunk, *, wall_s=None, candidates=None,
                quarantined=False, dead_letter=False, retraces=0,
                dispatch_retries=0, headroom_frac=None, fallback=False,
-               canary=None):
+               canary=None, oom_events=0, oom_floor=False):
         """Fold one chunk's telemetry in; returns the verdict after it.
 
         ``candidates`` is the number of table rows above the hit
         threshold (the RFI-storm signal — NOT the 0/1 hit decision);
         ``headroom_frac`` is free-device-memory / limit when known;
         ``canary`` is the controller's :meth:`~.canary.CanaryController.
-        summary` dict (``injected`` + ``window_recall`` are consumed).
+        summary` dict (``injected`` + ``window_recall`` are consumed);
+        ``oom_events`` is this chunk's caught-RESOURCE_EXHAUSTED count
+        (degradation-ladder descents -> ``memory_pressure`` DEGRADED,
+        ISSUE 12) and ``oom_floor`` marks a chunk quarantined because
+        even the ladder's numpy floor OOMed (-> ``oom_floor``
+        CRITICAL); both decay on clean chunks like every non-sticky
+        condition, so the verdict recovers once pressure lifts.
         """
         with self._lock:
             self._updates += 1
@@ -251,6 +258,20 @@ class HealthEngine:
                 flag("numpy_fallback", DEGRADED,
                      "device search fell back to the numpy reference "
                      "path permanently (reference speed)", sticky=True)
+
+            if oom_events:
+                self._oom_events += int(oom_events)
+                flag("memory_pressure", DEGRADED,
+                     f"{int(oom_events)} RESOURCE_EXHAUSTED caught on "
+                     f"chunk {chunk} ({self._oom_events} this run) — "
+                     "the degradation ladder is re-dispatching smaller "
+                     "(byte-identical, slower)")
+            if oom_floor:
+                flag("oom_floor", CRITICAL,
+                     f"chunk {chunk} quarantined at the ladder floor: "
+                     "even the numpy reference path ran out of memory "
+                     "— this host cannot search chunks of this "
+                     "geometry at all")
 
             if headroom_frac is not None:
                 headroom_frac = float(headroom_frac)

@@ -134,6 +134,12 @@ METRIC_NAMES = {
     "putpu_fleet_units_requeued_total":
         "work units put back in the queue (expiry, revoke, release, "
         "error, or a completion the ledger did not back)",
+    "putpu_fleet_units_resharded_total":
+        "work units split smaller (a too_large release, or a lease "
+        "sized to a worker's reported memory budget)",
+    "putpu_fleet_wire_retries_total":
+        "fleet wire calls re-attempted after a transient transport "
+        "failure (flaky connect, reset socket)",
     "putpu_fleet_workers":
         "workers currently registered and alive",
     "putpu_health_incidents_total":
@@ -157,6 +163,23 @@ METRIC_NAMES = {
     "putpu_multibeam_batches_total":
         "batched multi-beam dispatches (one device program serving N "
         "beam-chunks)",
+    "putpu_oom_admission_capped_total":
+        "service co-batches truncated by memory admission control",
+    "putpu_oom_events_total":
+        "RESOURCE_EXHAUSTED failures caught by the degradation ladder "
+        "(labelled by surface)",
+    "putpu_oom_floor_total":
+        "chunks quarantined as oom_floor (even the numpy reliability "
+        "floor ran out of memory)",
+    "putpu_oom_headroom_at_failure_bytes":
+        "device headroom observed at the last caught OOM (the "
+        "estimator's calibration signal)",
+    "putpu_oom_ladder_steps_total":
+        "degradation-ladder descents (labelled by step)",
+    "putpu_oom_splits_total":
+        "dispatch-splitting decisions under memory pressure (labelled "
+        "by stage: preflight = split planned before compiling, ladder "
+        "= split after a caught OOM)",
     "putpu_persist_dead_letter_total":
         "candidate persists abandoned to the dead-letter manifest",
     "putpu_plan_cache_hits_total":

@@ -77,6 +77,23 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
             if m.get("type") == "counter" and not m.get("labels"):
                 totals[m["name"]] = m.get("value")
         rec["counters"] = {k: totals[k] for k in sorted(totals)}
+        # memory-pressure rollup (ISSUE 12): the putpu_oom_* family is
+        # labelled (surface/step/stage), so the unlabelled-counter
+        # totals above miss it — aggregate it here for the "Memory
+        # pressure" section
+        oom = {}
+        for m in metrics:
+            name = m.get("name", "")
+            if not name.startswith("putpu_oom_") or "value" not in m:
+                continue
+            labels = m.get("labels") or {}
+            tag = name[len("putpu_"):]
+            if labels:
+                tag += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+            oom[tag] = oom.get(tag, 0) + m["value"]
+        if oom:
+            rec["memory_pressure"] = {k: oom[k] for k in sorted(oom)}
     return rec
 
 
@@ -289,6 +306,22 @@ def render_markdown(rec):
     else:
         lines.append("Single-process run: no fleet coordinator was "
                      "involved.")
+    lines.append("")
+
+    lines.append("## Memory pressure")
+    lines.append("")
+    oom = rec.get("memory_pressure")
+    if oom:
+        lines.append(
+            "RESOURCE_EXHAUSTED was caught this run — the degradation "
+            "ladder re-dispatched smaller (byte-identical results, "
+            "slower; see docs/robustness.md \"Resource exhaustion\"):")
+        lines.append("")
+        lines.append(_md_table(("metric", "value"),
+                               [(k, _fmt(v)) for k, v in oom.items()]))
+    else:
+        lines.append("No memory pressure: no OOM events, ladder "
+                     "descents or admission caps this run.")
     lines.append("")
 
     lines.append("## Quarantine manifest")

@@ -630,28 +630,118 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
     # and never reproduce PR 1's 14x) — pre-tuner "auto" callers are
     # unaffected because the static fallback names the formulation the
     # old backend switch picked ("roll" on CPU, the gather elsewhere)
-    gather_kernel = _jax_search_kernel(capture_plane, chan_block, kernel,
-                                       packed_meta)
-    roof = roofline.begin()  # wall spans dispatch -> readback completion
-    with budget_bucket("search/dispatch"):
-        offs_dev = jnp.asarray(offset_blocks)  # attributed, not hoisted
-        out = gather_kernel(data, offs_dev)
-        budget_count("dispatches")
-    stacked = out[0] if capture_plane else out  # (nblocks, 5, dm_block)
-    with budget_bucket("search/readback"):
-        stacked = np.asarray(stacked)
-        budget_count("readbacks")
-    roofline.end(roof, "gather_sweep", gather_kernel, (data, offs_dev))
+    from ..resilience import ladder as _ladder
+    from ..resilience import memory_budget as _membudget
+
+    formulation = (kernel if kernel in ("gather", "roll")
+                   else ("roll" if jax.default_backend() == "cpu"
+                         else "gather"))
+    nblocks = len(offset_blocks)
+    # preflight (ISSUE 12): a dispatch whose footprint estimate exceeds
+    # measured headroom splits BEFORE compiling — no-op when headroom
+    # is unknown (the CPU default), so the default path is byte-inert
+    _membudget.preflight_direct(
+        formulation, nchan, nsamples, ndm, dm_block=dm_block,
+        chan_block=chan_block, capture_plane=bool(capture_plane),
+        nblocks=nblocks,
+        packed_nbits=packed_meta[0] if packed_meta else 0)
+    while True:
+        passes = _ladder.direct_plan(formulation, nblocks)
+        try:
+            stacked, plane_blocks = _dispatch_direct(
+                data, offset_blocks, capture_plane, chan_block, kernel,
+                packed_meta, passes)
+            break
+        except (ValueError, TypeError):
+            raise  # deterministic configuration error, never OOM
+        except Exception as exc:  # jax errors share no base class
+            if not _ladder.is_resource_exhausted(exc) \
+                    or _ladder.direct_maxed(formulation, nblocks):
+                raise
+            # RESOURCE_EXHAUSTED: descend the ladder and re-dispatch
+            # smaller — byte-identical by construction (per-trial rows
+            # are independent sums; gather columns are independent)
+            _ladder.oom_event("direct_sweep")
+            step = _ladder.direct_step(formulation)
+            logger.warning("direct sweep OOM (%r); ladder step %r",
+                           exc, step)
+            _ladder.descend(step)
+            _ladder.count_split("ladder")
+    if _membudget.allocator_reports_limit():
+        # calibration loop (ISSUE 12): fold this dispatch's allocator
+        # high-water mark against the model's estimate into the
+        # persisted per-geometry offset.  Gated on a REAL allocator
+        # limit — the CPU live-array fallback has no watermark to
+        # learn from (and must not pay a live_arrays sweep here).
+        _membudget.observe(nchan, nsamples, ndm, _membudget.estimate_direct(
+            nchan, nsamples, ndm, dm_block=dm_block,
+            chan_block=chan_block, formulation=formulation,
+            capture_plane=bool(capture_plane), dm_passes=passes,
+            packed_nbits=packed_meta[0] if packed_meta else 0)["total"])
     stacked = stacked.transpose(1, 0, 2).reshape(5, -1)[:, :ndm]
     (maxvalues, stds, best_snrs, best_windows,
      best_peaks) = unstack_scores(stacked)
     if capture_plane:  # keep device-resident (see _search_jax_pallas)
-        plane = out[1].reshape(-1, *out[1].shape[2:])
+        plane = plane_blocks.reshape(-1, *plane_blocks.shape[2:])
         if plane.shape[0] != ndm:  # slicing outside jit is a real copy
             plane = plane[:ndm]
     else:
         plane = None
     return maxvalues, stds, best_snrs, best_windows, best_peaks, plane
+
+
+def _dispatch_direct(data, offset_blocks, capture_plane, chan_block,
+                     formulation, packed_meta, passes):
+    """One direct-sweep dispatch at the given degradation level.
+
+    ``passes == 1`` is the exact pre-resilience path (single dispatch,
+    plane kept device-resident).  Degraded levels split the trial-block
+    axis into ``passes`` dispatches of the SAME compiled per-block body
+    — each pass's buffers die before the next dispatch, which is the
+    footprint reduction, and because only the ``lax.map``-ed outer axis
+    shrinks (every per-block shape is unchanged) the concatenated score
+    packs and captured plane are byte-identical to the unsplit run
+    (``tests/test_resilience.py`` pins it; splitting the *inner* time
+    axis was tested and rejected — XLA reassociates the channel
+    reduction when the column extent changes, see docs/robustness.md).
+    """
+    import jax.numpy as jnp
+
+    kernel_fn = _jax_search_kernel(capture_plane, chan_block, formulation,
+                                   packed_meta)
+    if passes <= 1:
+        roof = roofline.begin()  # wall spans dispatch -> readback
+        with budget_bucket("search/dispatch"):
+            offs_dev = jnp.asarray(offset_blocks)  # attributed
+            out = kernel_fn(data, offs_dev)
+            budget_count("dispatches")
+        stacked = out[0] if capture_plane else out  # (nblocks, 5, dmb)
+        with budget_bucket("search/readback"):
+            stacked = np.asarray(stacked)
+            budget_count("readbacks")
+        roofline.end(roof, "gather_sweep", kernel_fn, (data, offs_dev))
+        return stacked, (out[1] if capture_plane else None)
+    parts = []
+    planes = []
+    for sub in np.array_split(offset_blocks, passes):
+        if not len(sub):
+            continue
+        with budget_bucket("search/dispatch"):
+            offs_dev = jnp.asarray(sub)
+            out = kernel_fn(data, offs_dev)
+            budget_count("dispatches")
+        with budget_bucket("search/readback"):
+            parts.append(np.asarray(out[0] if capture_plane else out))
+            budget_count("readbacks")
+            if capture_plane:
+                # degraded mode trades plane residency for footprint:
+                # each pass's plane blocks spill to host so at most one
+                # pass's worth of plane lives in HBM
+                planes.append(np.asarray(out[1]))
+                budget_count("readbacks")
+    stacked = np.concatenate(parts, axis=0)
+    return stacked, (np.concatenate(planes, axis=0) if capture_plane
+                     else None)
 
 
 #: rescore-call row buckets (requested rows pad up to the next bucket);
@@ -1253,10 +1343,16 @@ def _search_jax_hybrid(data, trial_dms, start_freq, bandwidth, sample_time,
     # the fused program would burn a full seed-bucket exact rescore on
     # every chunk the certificate is about to skip (the survey majority),
     # while a non-certified chunk only pays one extra ~0.1 s round trip.
+    from ..resilience import ladder as _ladder
+
     fused_seed = (use_fused and not capture_plane
                   and ndm >= 3 * HYBRID_SEED_TOPK
                   and _pick_fdmt_tile(nsamples) > 0
-                  and (snr_floor is None or not noise_certificate))
+                  and (snr_floor is None or not noise_certificate)
+                  # OOM ladder "unfuse" rung (ISSUE 12): under memory
+                  # pressure the one-dispatch program splits back into
+                  # coarse + rescore (bit-identity already pinned)
+                  and not _ladder.unfuse_engaged())
     if fused_seed:
         # 1+2 fused: coarse sweep, device-side top-k seed selection and
         # exact seed rescore in ONE dispatch + ONE packed readback (each

@@ -41,7 +41,7 @@ from ..ops.fdmt import (
     fdmt_trial_dms,
 )
 from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
-from ..utils.logging_utils import budget_bucket, budget_count
+from ..utils.logging_utils import budget_bucket, budget_count, logger
 from ..utils.table import ResultTable
 from .mesh import fetch_global, pad_to_multiple
 
@@ -687,6 +687,16 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
     if fused is True and fused_why is not None:
         raise ValueError(f"fused=True not eligible: {fused_why}")
     use_fused = fused is not False and fused_why is None
+    from ..resilience import ladder as _ladder
+
+    if fused is None and use_fused and _ladder.unfuse_engaged():
+        # OOM ladder "unfuse" rung (ISSUE 12): under memory pressure
+        # the one-dispatch program splits back into its coarse +
+        # rescore composition, whose rescored set is already pinned
+        # bit-identical to the fused run (explicit fused=True still
+        # forces the fused program — the A/B baseline must not shift
+        # under a stale global level)
+        use_fused = False
 
     plane = None
     n_seed = n_need = 0
@@ -733,27 +743,53 @@ def sharded_hybrid_search(data, dmmin, dmmax, start_freq, bandwidth,
         for it in tables:
             flat += [jnp.asarray(it[k]) for k in
                      ("idx_low", "idx_high", "shift", "shift_high")]
+        from ..faults import inject as fault_inject
         from ..obs import roofline
 
-        roof = roofline.begin()
-        with budget_bucket("search/fused"):
-            # operand conversions stay inside the bucket (attributed);
-            # on the packed path the operand IS the raw packed bytes
-            fused_args = (raw_dev if pf is not None else data,
-                          jnp.asarray(idx_map),
-                          jnp.asarray(offsets_rs), jnp.asarray(cert_params),
-                          jnp.int32(roll_k), *flat)
-            packed = np.asarray(kernel_fn(*fused_args))
-            budget_count("dispatches")
-            budget_count("readbacks")
-        roofline.end(roof, "sharded_fused_hybrid", kernel_fn, fused_args)
-        (coarse, sel, seed_scores, n_seed, sel2, need_scores,
-         n_need) = unpack_fused_hybrid(packed, ndm, bucket, bucket2)
-        maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]
-        windows = np.rint(coarse[3]).astype(np.int32)
-        peaks = np.rint(coarse[4]).astype(np.int64)
-        cert_scores = coarse[5]
-    else:
+        try:
+            # the "mesh" fault site also fires HERE (not only in the
+            # pipeline's run_one): direct callers — stream_search's
+            # mesh route, tests — get the same injection seam; a
+            # times=1 spec already consumed at the pipeline seam is
+            # exhausted and no-ops here
+            fault_inject.fire("mesh", chunk=None)
+            roof = roofline.begin()
+            with budget_bucket("search/fused"):
+                # operand conversions stay inside the bucket
+                # (attributed); on the packed path the operand IS the
+                # raw packed bytes
+                fused_args = (raw_dev if pf is not None else data,
+                              jnp.asarray(idx_map),
+                              jnp.asarray(offsets_rs),
+                              jnp.asarray(cert_params),
+                              jnp.int32(roll_k), *flat)
+                packed = np.asarray(kernel_fn(*fused_args))
+                budget_count("dispatches")
+                budget_count("readbacks")
+            roofline.end(roof, "sharded_fused_hybrid", kernel_fn,
+                         fused_args)
+        except (ValueError, TypeError):
+            raise  # deterministic configuration error, never OOM
+        except Exception as exc:  # jax errors share no base class
+            if fused is True or not _ladder.is_resource_exhausted(exc):
+                raise
+            # the fused program's compound footprint OOMed: descend to
+            # the two-stage composition (the "unfuse" rung) — its
+            # rescored set is bit-identical to the fused one (ISSUE 12)
+            _ladder.oom_event("mesh_fused")
+            _ladder.descend("unfuse")
+            logger.warning("fused mesh hybrid hit RESOURCE_EXHAUSTED "
+                           "(%r); un-fusing to the two-stage "
+                           "composition", exc)
+            use_fused = False
+        else:
+            (coarse, sel, seed_scores, n_seed, sel2, need_scores,
+             n_need) = unpack_fused_hybrid(packed, ndm, bucket, bucket2)
+            maxvalues, stds, snrs = coarse[0], coarse[1], coarse[2]
+            windows = np.rint(coarse[3]).astype(np.int32)
+            peaks = np.rint(coarse[4]).astype(np.int64)
+            cert_scores = coarse[5]
+    if not use_fused:
         # ---- two-stage composition (plane capture / certificate mode /
         # forced A/B baseline): coarse program, scores mapped host-side
         # (a packed chunk rides through as raw bytes — the coarse

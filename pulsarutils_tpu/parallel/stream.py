@@ -184,7 +184,12 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     from ..obs.health import HealthEngine
     from ..obs.server import start_obs_server
     from ..obs.trace import set_track, span
+    from ..resilience import ladder as _ladder
     from ..utils.logging_utils import logger
+
+    # each stream session starts undegraded (OOM descents within the
+    # stream are sticky — a measured slowdown; ISSUE 12)
+    _ladder.reset()
 
     @contextlib.contextmanager
     def traced_chunk(istart):
@@ -226,9 +231,10 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
 
     def run_guarded(istart, chunk):
         last = None
-        for attempt in range(max(int(dispatch_retries), 0) + 1):
-            if attempt:
-                _metrics.counter("putpu_dispatch_retries_total").inc()
+        attempt = 0
+        oom_descents = 0
+        budget_attempts = max(int(dispatch_retries), 0) + 1
+        while attempt < budget_attempts:
             try:
                 return call_with_deadline(lambda: run_one(istart, chunk),
                                           dispatch_timeout)
@@ -236,9 +242,28 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                 raise  # deterministic configuration error
             except Exception as exc:  # jax errors share no base class
                 last = exc
+                if _ladder.is_resource_exhausted(exc) \
+                        and oom_descents < 2 * len(_ladder.STEPS):
+                    # RESOURCE_EXHAUSTED is not a transient dispatch
+                    # fault (ISSUE 12): descend the degradation ladder
+                    # — the re-dispatch runs smaller (split trial
+                    # passes; unfused mesh hybrid) and byte-identical —
+                    # without burning the transient retry budget
+                    _ladder.oom_event("stream")
+                    _ladder.descend("unfuse" if kernel == "hybrid"
+                                    else "split_dm")
+                    oom_descents += 1
+                    logger.warning(
+                        "stream chunk %s hit RESOURCE_EXHAUSTED (%r); "
+                        "ladder level %d, re-dispatching smaller",
+                        istart, exc, _ladder.level())
+                    continue
+                attempt += 1
+                if attempt < budget_attempts:
+                    _metrics.counter("putpu_dispatch_retries_total").inc()
                 logger.warning("stream chunk %s search failed (%r); "
                                "%s", istart, exc,
-                               "retrying" if attempt < dispatch_retries
+                               "retrying" if attempt < budget_attempts
                                else "giving up")
         raise last
 
@@ -272,10 +297,20 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                                    host=http_host)
                   if http_port is not None else None)
 
+    def _oom_events_total():
+        return sum(m.get("value", 0)
+                   for m in _metrics.REGISTRY.snapshot()
+                   if m.get("name") == "putpu_oom_events_total")
+
+    health_oom_base = [_oom_events_total()] if health is not None else None
+
     def _health_update(istart, wall_s, candidates=None, contained=False):
         if health is not None:
+            oom_now = _oom_events_total()
+            oom_delta = oom_now - health_oom_base[0]
+            health_oom_base[0] = oom_now
             health.update(istart, wall_s=wall_s, candidates=candidates,
-                          quarantined=contained,
+                          quarantined=contained, oom_events=oom_delta,
                           canary=canary.summary()
                           if canary is not None else None)
 

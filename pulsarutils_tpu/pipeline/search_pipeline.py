@@ -48,6 +48,7 @@ from ..ops.search import dedispersion_search
 from ..parallel.stream import iter_chunk_starts, plan_chunks
 from ..pipeline.pulse_info import PulseInfo
 from ..pipeline.spectral_stats import get_bad_chans
+from ..resilience import ladder as _resilience_ladder
 from ..utils.logging_utils import (BudgetAccountant, logger,
                                    measure_device_rtt)
 from ..utils.table import ResultTable
@@ -97,14 +98,22 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
     chunk pays one coarse dispatch and no seed rescore — the same
     gating as the single-device fused path.
     """
+    from ..resilience import ladder as _ladder
+
     policy = policy if policy is not None else DispatchPolicy()
     state = state if state is not None else {}
     bk = state.get("backend", backend)
     kern = state.get("kernel", kernel)
-    attempts = [(bk, kern)] * (1 + max(int(policy.retries), 0))
+    # attempt tuples carry an oom_retry flag: a RESOURCE_EXHAUSTED is
+    # NOT one of the transient faults the retry budget exists for
+    # (retrying the identical dispatch would OOM identically) — it gets
+    # ladder descents instead, counted as putpu_oom_* rather than
+    # putpu_dispatch_retries_total (ISSUE 12)
+    attempts = [(bk, kern, False)] * (1 + max(int(policy.retries), 0))
     if bk != "numpy":
-        attempts.append(("numpy", "auto"))
+        attempts.append(("numpy", "auto", False))
     last = None
+    oom_descents = 0
 
     def run_one(b, k):
         if b != "numpy":
@@ -114,6 +123,11 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
             # the run through the very fallback the harness must prove
             # (code-review r8)
             fault_inject.fire("dispatch", chunk=chunk, backend=b)
+        else:
+            # the OOM drill's floor seam: only kind="oom" specs target
+            # the "host" site, so every pre-existing dispatch-fault
+            # class still proves the numpy fallback un-injected
+            fault_inject.fire("host", chunk=chunk, backend=b)
         if mesh is not None and b == "jax":
             fault_inject.fire("mesh", chunk=chunk)
             # plane capture on the mesh path stays DM-sharded and
@@ -143,16 +157,19 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
             backend=b, kernel=k, capture_plane=capture_plane,
             **({"snr_floor": snr_floor} if k == "hybrid" else {}))
 
-    for i, (b, k) in enumerate(attempts):
+    i = 0
+    while i < len(attempts):
+        b, k, oom_retry = attempts[i]
         try:
             # the numpy reference path is the reliability floor: no
             # watchdog (a deadline there would turn the last-resort
             # fallback into another way to fail)
             timeout = policy.timeout_s if b != "numpy" else None
-            if i and (b, k) == (bk, kern):
+            if i and (b, k) == (bk, kern) and not oom_retry:
                 # a same-backend RETRY: counted, backed off, and traced
                 # as one — the numpy fallback attempt is neither (span
-                # and counter must agree; code-review r8)
+                # and counter must agree; code-review r8), and an OOM
+                # ladder re-dispatch is counted under putpu_oom_*
                 obs_metrics.counter("putpu_dispatch_retries_total").inc()
                 if policy.backoff_s:
                     time.sleep(policy.backoff_s * (2 ** (i - 1)))
@@ -170,14 +187,42 @@ def _search_with_fallback(array, dmmin, dmmax, start_freq, bandwidth,
             return result
         except (ValueError, TypeError):
             raise  # deterministic configuration error
+        except _ladder.OOMFloorError:
+            raise  # already classified at a deeper rung
         except Exception as exc:  # jax runtime errors share no base class
             last = exc
-            if i + 1 < len(attempts):
+            if _ladder.is_resource_exhausted(exc):
+                # RESOURCE_EXHAUSTED — distinguished from the transient
+                # dispatch faults above (ISSUE 12).  On a device rung:
+                # descend the degradation ladder and re-dispatch
+                # smaller (byte-identical by construction).  On the
+                # numpy floor: the chunk cannot be searched on this
+                # host at all — quarantine it (oom_floor), never wedge
+                # or kill the survey.
+                _ladder.oom_event("chunk_search")
+                if b == "numpy":
+                    raise _ladder.OOMFloorError(
+                        f"chunk {chunk}: the numpy reliability floor "
+                        f"itself ran out of memory ({exc!r}); "
+                        "quarantining the chunk as oom_floor") from exc
+                step = ("unfuse" if k == "hybrid" else "split_dm")
+                _ladder.descend(step)
+                if oom_descents < 2 * len(_ladder.STEPS):
+                    oom_descents += 1
+                    attempts.insert(i + 1, (b, k, True))
+                logger.warning(
+                    "chunk %s search hit RESOURCE_EXHAUSTED on "
+                    "backend=%s kernel=%s (%r); degradation ladder "
+                    "step %r, re-dispatching smaller", chunk, b, k,
+                    exc, step)
+            elif i + 1 < len(attempts):
                 nxt = attempts[i + 1]
                 logger.warning(
                     "chunk search failed on backend=%s kernel=%s (%r); "
                     "retrying with backend=%s kernel=%s", b, k, exc,
                     nxt[0], nxt[1])
+            i += 1
+            continue
     raise last
 
 
@@ -580,6 +625,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
 
     timer = budget if budget is not None else BudgetAccountant()
     timer.begin_stream()  # reused accountants: retrace baseline per run
+    # each survey session starts undegraded: within a run OOM descents
+    # are sticky (a measured slowdown, not a crash loop); a fresh run
+    # rediscovers pressure through the preflight estimator (ISSUE 12)
+    _resilience_ladder.reset()
 
     with_timer = timer.bucket
     with with_timer("badchans"):
@@ -750,16 +799,24 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                       host=http_host)
 
     # health consumes per-chunk DELTAS of process-wide counters (other
-    # runs in this process may have bumped them already)
+    # runs in this process may have bumped them already).  OOM events
+    # arrive per surface label, so the delta is over the labelled sum.
+    def _oom_events_total():
+        return sum(
+            m.get("value", 0)
+            for m in obs_metrics.REGISTRY.snapshot()
+            if m.get("name") == "putpu_oom_events_total")
+
     health_base = {}
     if health is not None:
         for key, name in (("dead", "putpu_persist_dead_letter_total"),
                           ("retry", "putpu_dispatch_retries_total"),
                           ("retrace", "putpu_retraces_total")):
             health_base[key] = obs_metrics.counter(name).value
+        health_base["oom"] = _oom_events_total()
 
     def _health_update(istart, wall_s, candidates=None, quarantined=False,
-                       headroom_frac=None):
+                       headroom_frac=None, oom_floor=False):
         if health is None:
             return
         deltas = {}
@@ -769,11 +826,15 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             v = obs_metrics.counter(name).value
             deltas[key] = v - health_base[key]
             health_base[key] = v
+        oom_now = _oom_events_total()
+        oom_delta = oom_now - health_base["oom"]
+        health_base["oom"] = oom_now
         health.update(
             istart, wall_s=wall_s, candidates=candidates,
             quarantined=quarantined, dead_letter=deltas["dead"] > 0,
             dispatch_retries=deltas["retry"],
             retraces=deltas["retrace"], headroom_frac=headroom_frac,
+            oom_events=oom_delta, oom_floor=oom_floor,
             fallback=bool(backend != "numpy"
                           and fallback_state.get("backend") == "numpy"),
             canary=canary.summary() if canary is not None else None)
@@ -1131,13 +1192,41 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # blocking search (see prefetch_upload)
             array_dev = prefetch_upload(next_read)
 
-            with with_timer("search"):
-                result = _search_with_fallback(
-                    array, dmmin, dmmax, start_freq, bandwidth, eff_tsamp,
-                    backend=backend, kernel=kernel, capture_plane=capture,
-                    state=fallback_state, mesh=mesh,
-                    snr_floor=search_snr_floor, chunk=istart,
-                    policy=dispatch_policy)
+            try:
+                with with_timer("search"):
+                    result = _search_with_fallback(
+                        array, dmmin, dmmax, start_freq, bandwidth,
+                        eff_tsamp, backend=backend, kernel=kernel,
+                        capture_plane=capture, state=fallback_state,
+                        mesh=mesh, snr_floor=search_snr_floor,
+                        chunk=istart, policy=dispatch_policy)
+            except _resilience_ladder.OOMFloorError as exc:
+                # the degradation ladder's floor itself OOMed: this
+                # chunk cannot be searched on this host at ANY geometry
+                # — quarantine it (manifest + done-with-reason, exact
+                # resume) and keep the survey alive (ISSUE 12)
+                obs_metrics.counter("putpu_oom_floor_total").inc()
+                obs_metrics.counter(
+                    "putpu_chunks_quarantined_total").inc()
+                logger.error("chunk %d-%d QUARANTINED (oom_floor): %r "
+                             "-> %s", istart, iend, exc, manifest.path)
+                manifest.record(istart, iend, "oom_floor",
+                                {"error": repr(exc)})
+                if persist_pool is not None:
+                    persist_futures.append(persist_pool.submit(
+                        _persist_async, None, istart, iend,
+                        reason="oom_floor"))
+                else:
+                    with with_timer("persist"):
+                        _persist_and_mark(None, istart, iend,
+                                          reason="oom_floor")
+                nproc += 1
+                if canary is not None:
+                    canary.discard(istart)
+                _health_update(istart,
+                               wall_s=time.perf_counter() - t_chunk,
+                               quarantined=True, oom_floor=True)
+                continue
             table, plane = result if capture else (result, None)
             if reader.ibeam is not None:
                 # chunk metadata rides the in-process table (meta is not

@@ -1,0 +1,36 @@
+"""Resource-exhaustion resilience: preflight memory budgeting and the
+OOM degradation ladder (ISSUE 12).
+
+Device memory exhaustion was the one fault class the chaos matrix
+(PR 4) and the fleet drill (PR 9) could not survive: a single
+``RESOURCE_EXHAUSTED`` from XLA killed the chunk — and, with co-batched
+jobs (PR 8), every tenant in the batch.  A dedispersion dispatch's
+footprint is a strong, *predictable* function of its geometry (the
+memory-bound roll/sum over ``nchan x nsamples x nDM``, arxiv
+1201.5380), so OOM is forecastable before dispatch and recoverable
+after it by re-dispatching at a smaller geometry — exactly the way an
+inference serving stack sheds batch size under memory pressure.
+
+* :mod:`.memory_budget` — the preflight HBM footprint estimator, keyed
+  by the tuner's :func:`~pulsarutils_tpu.tuning.geometry.geometry_key`
+  and validated against the per-chunk watermarks
+  :mod:`~pulsarutils_tpu.obs.memory` already records, with a
+  calibration offset persisted beside the tune cache;
+* :mod:`.ladder` — the degradation ladder a caught OOM descends:
+  halve the gather's time window, split the trial grid into passes,
+  un-fuse the hybrid, halve the beam batch, and finally the numpy
+  reference path — every device rung proven byte-identical to the
+  unsplit dispatch (per-trial rows are independent sums in both
+  formulations; gather output columns are independent), counted and
+  surfaced as :class:`~pulsarutils_tpu.obs.health.HealthEngine`
+  conditions.
+"""
+
+from .ladder import (  # noqa: F401
+    OOMFloorError,
+    is_resource_exhausted,
+)
+from .memory_budget import estimate_direct, headroom_bytes  # noqa: F401
+
+__all__ = ["OOMFloorError", "is_resource_exhausted", "estimate_direct",
+           "headroom_bytes"]

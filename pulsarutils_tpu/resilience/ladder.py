@@ -1,0 +1,181 @@
+"""The OOM degradation ladder: classify, count, descend, recover.
+
+A caught ``RESOURCE_EXHAUSTED`` is **not** one of the transient
+dispatch faults PR 4 retries — retrying the identical dispatch would
+OOM identically.  Instead the run descends an explicit ladder of
+smaller re-dispatches, every device rung proven byte-identical to the
+unsplit run:
+
+=========== ================================================= ==========
+step        mechanism                                          surface
+=========== ================================================= ==========
+split_dm    the trial-block axis dispatches in 2, 4, ...       direct
+            passes — only the ``lax.map``-ed outer axis        sweep
+            shrinks, every per-block compiled body keeps its
+            exact shape, so per-trial scores are exact (both
+            formulations)
+unfuse      the fused hybrid's one-dispatch program splits     hybrids
+            back into coarse + rescore programs (fused ==
+            unfused is already pinned bit-identical, PR 2/8)
+halve_batch an N-beam batch re-dispatches as two half-batches  beams
+            (``lax.map`` runs the identical per-beam trace)
+numpy_floor the reference path — the reliability floor; a      chunk
+            MemoryError *here* means the chunk cannot be       loop
+            searched on this host at all and is quarantined
+            as ``oom_floor``
+=========== ================================================= ==========
+
+Splitting the *time* axis (the issue's first-sketched rung) was built,
+tested and REJECTED: a gather window whose column extent differs is a
+different XLA program, and XLA:CPU measurably reassociates the channel
+reduction across that boundary — the plane values drift at float32 ulp
+scale, violating the byte-identity contract every rung must carry.
+The surviving rungs all shrink an outer *mapped* axis (trial blocks,
+beams) or swap to an already-pinned-identical composition, which is
+what makes their proof structural instead of hopeful.
+
+State is ONE process-global level (device memory is a global
+resource), reset at the start of each driver session
+(:func:`reset`): within a run the degradation is sticky — a
+self-healing slowdown, not a crash loop — and a fresh run rediscovers
+pressure from the estimator/preflight at near-zero cost.
+
+Counters (:mod:`~pulsarutils_tpu.obs.names`):
+``putpu_oom_events_total`` (caught OOMs, labelled by surface),
+``putpu_oom_ladder_steps_total`` (descents, labelled by step),
+``putpu_oom_splits_total`` (splitting decisions, labelled by stage:
+``preflight`` split planned before compiling vs ``ladder`` split after
+a caught OOM), and the ``putpu_oom_headroom_at_failure_bytes`` gauge
+(headroom observed at the last failure — the estimator's calibration
+signal).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..obs import metrics as _metrics
+
+__all__ = ["OOMFloorError", "is_resource_exhausted", "reset", "level",
+           "descend", "direct_plan", "direct_maxed", "unfuse_engaged",
+           "oom_event", "count_split", "STEPS"]
+
+#: the documented descent order (see module docstring / docs/robustness.md)
+STEPS = ("split_dm", "unfuse", "halve_batch", "numpy_floor")
+
+#: substrings that mark a device allocator failure.  jax runtime errors
+#: share no usable base class across versions, so classification is by
+#: the XLA status text (``XlaRuntimeError: RESOURCE_EXHAUSTED: Out of
+#: memory ...``) — which the ``kind="oom"`` fault injection reproduces
+#: verbatim so drills exercise this exact classifier.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+                "Out of memory", "out of memory")
+
+_lock = threading.Lock()
+_LEVEL = 0
+
+
+class OOMFloorError(RuntimeError):
+    """The degradation ladder's floor itself ran out of memory: the
+    chunk cannot be searched on this host at any geometry.  The chunk
+    loop quarantines the chunk with reason ``oom_floor`` (manifest +
+    done-with-reason in the ledger, exact resume) instead of letting
+    the failure kill or wedge the survey."""
+
+
+def is_resource_exhausted(exc):
+    """True when ``exc`` is device/host memory exhaustion.
+
+    ``MemoryError`` always qualifies; any other exception qualifies by
+    the XLA status markers in its message.  A plain injected transient
+    dispatch fault (``FAULTPLAN: injected dispatch error``) carries no
+    marker, so the PR 4 retry path keeps owning it.
+    """
+    if isinstance(exc, MemoryError):
+        return True
+    if isinstance(exc, (ValueError, TypeError)):
+        return False  # deterministic configuration errors, never OOM
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+# -- state -------------------------------------------------------------------
+
+def reset():
+    """Back to the undegraded level (driver session start; tests)."""
+    global _LEVEL
+    with _lock:
+        _LEVEL = 0
+
+
+def level():
+    """The current global degradation level (0 = undegraded)."""
+    return _LEVEL
+
+
+def descend(step):
+    """One ladder descent: bump the global level, count the step.
+    Returns the new level."""
+    global _LEVEL
+    with _lock:
+        _LEVEL += 1
+        new = _LEVEL
+    _metrics.counter("putpu_oom_ladder_steps_total", step=step).inc()
+    return new
+
+
+def oom_event(surface, headroom=None):
+    """Count one caught RESOURCE_EXHAUSTED; record the headroom the
+    allocator reported at failure (the calibration signal)."""
+    _metrics.counter("putpu_oom_events_total", surface=surface).inc()
+    if headroom is None:
+        from . import memory_budget as _mb
+
+        headroom = _mb.headroom_bytes()
+    if headroom is not None:
+        _metrics.gauge("putpu_oom_headroom_at_failure_bytes").set(
+            int(headroom))
+
+
+def count_split(stage, n=1):
+    """Count ``n`` splitting decisions (``stage`` is ``preflight`` —
+    planned before compiling — or ``ladder`` — taken after a caught
+    OOM)."""
+    if n > 0:
+        _metrics.counter("putpu_oom_splits_total", stage=stage).inc(int(n))
+
+
+# -- per-surface interpretations of the global level -------------------------
+
+def direct_plan(formulation, nblocks):
+    """Trial-block passes for the direct sweep at the current level.
+
+    Level 0 is the exact pre-resilience dispatch (one pass).  Each
+    descent doubles the pass count — the trial blocks dispatch in
+    2, 4, ... groups whose per-block compiled bodies are
+    shape-identical to the unsplit program's — floor-bounded at one
+    block per dispatch.  (``formulation`` is accepted for future
+    formulation-specific rungs; both current formulations split the
+    same way.)
+    """
+    lvl = _LEVEL
+    if lvl <= 0:
+        return 1
+    return min(2 ** lvl, max(int(nblocks), 1))
+
+
+def direct_maxed(formulation, nblocks):
+    """True when the direct sweep has no smaller dispatch left."""
+    return direct_plan(formulation, nblocks) >= max(int(nblocks), 1)
+
+
+def direct_step(formulation):
+    """The step name the NEXT direct-sweep descent takes."""
+    return "split_dm"
+
+
+def unfuse_engaged():
+    """True once any descent happened: the fused hybrids (single-device
+    TPU program, mesh ``shard_map`` program) drop to their two-stage
+    composition — already pinned bit-identical to the fused run."""
+    return _LEVEL >= 1

@@ -430,7 +430,11 @@ def test_search_fallback_survives_device_failure(monkeypatch):
     def flaky(data, *args, backend="numpy", **kw):
         calls.append(backend)
         if backend == "jax":
-            raise RuntimeError("RESOURCE_EXHAUSTED: fake TPU crash")
+            # a GENERIC device crash, deliberately not OOM-shaped: a
+            # RESOURCE_EXHAUSTED message would route to the degradation
+            # ladder instead of this retry-then-fallback path since
+            # ISSUE 12 (that path is pinned in tests/test_resilience.py)
+            raise RuntimeError("INTERNAL: fake TPU crash")
         return real(data, *args, backend=backend, **kw)
 
     monkeypatch.setattr(sp, "dedispersion_search", flaky)

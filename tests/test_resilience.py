@@ -1,0 +1,661 @@
+"""OOM-resilient dispatch (ISSUE 12): preflight memory budgeting, the
+degradation ladder, and admission control.
+
+The contracts under test:
+
+* classification — ``RESOURCE_EXHAUSTED`` is recognised (and the PR 4
+  transient faults are NOT), the ``kind="oom"`` injection raises the
+  real production shape;
+* byte identity — every ladder rung re-dispatches byte-identical work:
+  the direct sweep's split trial passes (roll + gather), the mesh
+  hybrid's un-fuse, the beam batch halving (packed + float), end to
+  end through ``search_by_chunks`` / ``stream_search``;
+* containment — a persistent floor OOM quarantines the chunk as
+  ``oom_floor`` (exact resume, clean audit) instead of killing the
+  survey, and the health verdict walks DEGRADED/CRITICAL -> OK;
+* admission — the service caps co-batches to the memory budget, a
+  fleet worker's ``too_large`` release makes the coordinator re-shard
+  the unit smaller (over the real HTTP wire), and fleet wire calls
+  survive transient transport failures.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+from pulsarutils_tpu.faults import inject as fault_inject
+from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+from pulsarutils_tpu.models.simulate import disperse_array
+from pulsarutils_tpu.obs.metrics import REGISTRY
+from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+from pulsarutils_tpu.resilience import ladder
+from pulsarutils_tpu.resilience import memory_budget as membudget
+
+pytestmark = pytest.mark.chaos
+
+TSAMP = 0.0005
+NCHAN = 64
+NSAMPLES = 32768
+CHUNK_LEN_S = 8192 * TSAMP          # -> step 16384, hop 8192
+PULSE_T = 20000                     # noise chunk: 0; hit chunks: 8192, 16384
+SEARCH_KW = dict(dmmin=100, dmmax=200, backend="jax",
+                 chunk_length=CHUNK_LEN_S, make_plots=False,
+                 progress=False, snr_threshold=6.5)
+GEOM = (1200.0, 200.0, TSAMP)       # start_freq, bandwidth, tsamp
+
+
+def _csum(name):
+    """Counter total across every label set."""
+    return sum(r["value"] for r in REGISTRY.snapshot()
+               if r["name"] == name)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ladder():
+    """Every test starts (and leaves) the global ladder undegraded —
+    a failed assertion must not leak a degraded level into later
+    tests or other modules."""
+    ladder.reset()
+    yield
+    ladder.reset()
+
+
+@pytest.fixture(scope="module")
+def survey_file(tmp_path_factory):
+    from pulsarutils_tpu.pipeline.spectral_stats import get_bad_chans
+
+    tmp = tmp_path_factory.mktemp("resilience")
+    rng = np.random.default_rng(0)
+    array = np.abs(rng.normal(0, 0.5, (NCHAN, NSAMPLES))) + 20.0
+    array[:, PULSE_T] += 4.0
+    array = disperse_array(array, 150, 1200., 200., TSAMP)
+    sim_header = {"bandwidth": 200., "fbottom": 1200., "nchans": NCHAN,
+                  "nsamples": NSAMPLES, "tsamp": TSAMP,
+                  "foff": 200. / NCHAN}
+    path = str(tmp / "survey.fil")
+    write_simulated_filterbank(path, array, sim_header, descending=True)
+    get_bad_chans(path)
+    return path
+
+
+def _snapshot(outdir, fingerprint):
+    with open(os.path.join(outdir, f"progress_{fingerprint}.json"),
+              "rb") as f:
+        ledger = f.read()
+    cands = {}
+    for name in sorted(os.listdir(outdir)):
+        if name.endswith(".npz"):
+            with np.load(os.path.join(outdir, name),
+                         allow_pickle=False) as d:
+                cands[name] = {k: d[k].tobytes() for k in d.files}
+    return ledger, cands
+
+
+# ---------------------------------------------------------------------------
+# classification + injection shape
+# ---------------------------------------------------------------------------
+
+def test_is_resource_exhausted_classifier():
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert ladder.is_resource_exhausted(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying "
+                        "to allocate 17179869184 bytes."))
+    assert ladder.is_resource_exhausted(MemoryError())
+    assert ladder.is_resource_exhausted(
+        RuntimeError("Resource exhausted: ran out of HBM"))
+    # PR 4's transient faults stay with the retry path
+    assert not ladder.is_resource_exhausted(
+        RuntimeError("FAULTPLAN: injected dispatch error (chunk=0)"))
+    # deterministic configuration errors are never OOM, whatever the text
+    assert not ladder.is_resource_exhausted(
+        ValueError("Out of memory-shaped but a config error"))
+
+
+def test_inject_oom_kind_is_production_shaped():
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="oom", times=1),
+                      FaultSpec(site="host", kind="oom", times=1)])
+    with plan.armed():
+        with pytest.raises(Exception) as exc_info:
+            fault_inject.fire("dispatch", chunk=0)
+        exc = exc_info.value
+        assert type(exc).__name__ == "XlaRuntimeError"
+        assert "RESOURCE_EXHAUSTED" in str(exc)
+        assert ladder.is_resource_exhausted(exc)
+        # the ladder-floor seam raises host memory exhaustion
+        with pytest.raises(MemoryError):
+            fault_inject.fire("host", chunk=0)
+    assert plan.fired() == 2
+
+
+# ---------------------------------------------------------------------------
+# the ladder's level plumbing
+# ---------------------------------------------------------------------------
+
+def test_direct_plan_levels_and_maxing():
+    assert ladder.direct_plan("roll", nblocks=8) == 1
+    assert not ladder.unfuse_engaged()
+    ladder.descend("split_dm")
+    assert ladder.direct_plan("roll", nblocks=8) == 2
+    assert ladder.unfuse_engaged()
+    ladder.descend("split_dm")
+    assert ladder.direct_plan("gather", nblocks=8) == 4
+    assert not ladder.direct_maxed("gather", nblocks=8)
+    ladder.descend("split_dm")
+    assert ladder.direct_plan("gather", nblocks=8) == 8
+    assert ladder.direct_maxed("gather", nblocks=8)
+    # the pass count floors at one block per dispatch
+    ladder.descend("split_dm")
+    assert ladder.direct_plan("roll", nblocks=8) == 8
+    ladder.reset()
+    assert ladder.direct_plan("roll", nblocks=8) == 1
+
+
+# ---------------------------------------------------------------------------
+# estimator + calibration
+# ---------------------------------------------------------------------------
+
+def test_estimate_direct_terms_scale():
+    one = membudget.estimate_direct(64, 4096, 128)
+    assert set(one) == {"operand", "workspace", "scoring", "outputs",
+                        "total"}
+    assert one["total"] == sum(v for k, v in one.items() if k != "total")
+    # the batch axis multiplies the operand only (lax.map serialises
+    # per-beam bodies)
+    four = membudget.estimate_direct(64, 4096, 128, batch=4)
+    assert four["operand"] == 4 * one["operand"]
+    assert four["workspace"] == one["workspace"]
+    # a packed operand adds the raw frames on top of the float view
+    packed = membudget.estimate_direct(64, 4096, 128, packed_nbits=2)
+    assert packed["operand"] == one["operand"] + 64 * 4096 * 2 // 8
+    # plane capture dominates the output side
+    cap = membudget.estimate_direct(64, 4096, 128, capture_plane=True)
+    assert cap["outputs"] > one["outputs"]
+    # splitting trial passes shrinks the per-dispatch outputs
+    split = membudget.estimate_direct(64, 4096, 128, capture_plane=True,
+                                      dm_passes=4)
+    assert split["outputs"] < cap["outputs"]
+
+
+def test_budget_env_override(monkeypatch):
+    monkeypatch.setenv(membudget.MEM_LIMIT_ENV, "123456789")
+    assert membudget.device_budget_bytes() == 123456789
+    assert membudget.headroom_bytes() is not None
+    monkeypatch.delenv(membudget.MEM_LIMIT_ENV)
+    # CPU's live-array fallback reports no limit: budget unknown
+    assert membudget.device_budget_bytes() is None
+    assert membudget.headroom_bytes() is None
+
+
+def test_calibration_roundtrip_beside_tune_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PUTPU_TUNE_CACHE",
+                       str(tmp_path / "a" / "tune_cache.json"))
+    path = membudget.calibration_path()
+    assert os.path.dirname(path) == str(tmp_path / "a")
+    assert membudget.calibration_offset("k") == 1.0
+    membudget.record_calibration("k", estimated=100.0, measured=50.0)
+    assert membudget.calibration_offset("k") == pytest.approx(0.5)
+    assert membudget.calibrated("k", 200.0) == pytest.approx(100.0)
+    assert os.path.exists(path)
+    # EWMA folding: a later outlier moves the offset 30%, not all the way
+    membudget.record_calibration("k", estimated=100.0, measured=150.0)
+    assert membudget.calibration_offset("k") \
+        == pytest.approx(0.7 * 0.5 + 0.3 * 1.5)
+    # a torn calibration file degrades to the raw model, never fails
+    monkeypatch.setenv("PUTPU_TUNE_CACHE",
+                       str(tmp_path / "b" / "tune_cache.json"))
+    os.makedirs(tmp_path / "b")
+    with open(membudget.calibration_path(), "w") as f:
+        f.write("{torn")
+    assert membudget.calibration_offset("k") == 1.0
+
+
+def test_preflight_splits_before_dispatch(monkeypatch, rng):
+    """A dispatch whose estimate exceeds PUTPU_MEM_LIMIT splits before
+    compiling — and the split table is byte-identical to the
+    unconstrained one."""
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    data = np.abs(rng.normal(0, 1, (64, 4096))).astype(np.float32) + 5
+    kw = dict(dmmin=100, dmmax=300, start_freq=1200., bandwidth=200.,
+              sample_time=TSAMP, backend="jax", kernel="roll")
+    t_free = dedispersion_search(data, **kw)
+    ladder.reset()
+    monkeypatch.setenv(membudget.MEM_LIMIT_ENV, "100000")  # ~100 kB
+    before = _csum("putpu_oom_splits_total")
+    t_tight = dedispersion_search(data, **kw)
+    assert _csum("putpu_oom_splits_total") > before
+    assert ladder.level() > 0
+    for col in t_free.colnames:
+        assert np.array_equal(np.asarray(t_free[col]),
+                              np.asarray(t_tight[col])), col
+
+
+@pytest.mark.parametrize("kernel", ["roll", "gather"])
+def test_direct_sweep_split_byte_identity(kernel, rng):
+    """The split_dm rung: every degradation level's table equals the
+    level-0 table byte for byte, both formulations."""
+    from pulsarutils_tpu.ops.search import dedispersion_search
+
+    data = np.abs(rng.normal(0, 1, (64, 4096))).astype(np.float32) + 5
+    kw = dict(dmmin=100, dmmax=300, start_freq=1200., bandwidth=200.,
+              sample_time=TSAMP, backend="jax", kernel=kernel)
+    t0 = dedispersion_search(data, **kw)
+    for _ in range(3):
+        ladder.descend("split_dm")
+        t = dedispersion_search(data, **kw)
+        for col in t0.colnames:
+            assert np.array_equal(np.asarray(t0[col]),
+                                  np.asarray(t[col])), \
+                f"{col} diverged at ladder level {ladder.level()}"
+
+
+# ---------------------------------------------------------------------------
+# end to end: search_by_chunks / stream_search / mesh hybrid / beams
+# ---------------------------------------------------------------------------
+
+def test_search_by_chunks_transient_oom_byte_identical(survey_file,
+                                                       tmp_path):
+    """One injected RESOURCE_EXHAUSTED: the ladder descends, the run
+    recovers, and candidates + ledger match the clean run byte for
+    byte; health flags memory_pressure and decays back to OK."""
+    from pulsarutils_tpu.obs.health import HealthEngine
+
+    _, store = search_by_chunks(survey_file,
+                                output_dir=str(tmp_path / "clean"),
+                                **SEARCH_KW)
+    base = _snapshot(str(tmp_path / "clean"), store.fingerprint)
+
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="oom", chunks=(0,),
+                                times=1)])
+    engine = HealthEngine()
+    before = _csum("putpu_oom_events_total")
+    with plan.armed():
+        search_by_chunks(survey_file, output_dir=str(tmp_path / "oom"),
+                         health=engine, **SEARCH_KW)
+    assert plan.fired() == 1
+    assert _csum("putpu_oom_events_total") > before
+    assert _snapshot(str(tmp_path / "oom"), store.fingerprint) == base
+    kinds = [t["to"] for t in engine.transitions]
+    assert "DEGRADED" in kinds and engine.verdict == "OK"
+    assert any("memory_pressure" in t["reasons"]
+               for t in engine.transitions)
+
+
+def test_oom_floor_quarantines_and_resumes_exactly(survey_file, tmp_path):
+    """Persistent floor OOM on one chunk: quarantined as oom_floor,
+    audit clean, resume searches nothing again, verdict CRITICAL -> OK."""
+    from pulsarutils_tpu.faults.audit import audit_run
+    from pulsarutils_tpu.obs.health import HealthEngine
+
+    plan = FaultPlan([
+        FaultSpec(site="dispatch", kind="oom", chunks=(0,), times=None),
+        FaultSpec(site="host", kind="oom", chunks=(0,), times=None)])
+    engine = HealthEngine()
+    before = _csum("putpu_oom_floor_total")
+    with plan.armed():
+        hits, store = search_by_chunks(survey_file,
+                                       output_dir=str(tmp_path),
+                                       health=engine, **SEARCH_KW)
+    assert _csum("putpu_oom_floor_total") == before + 1
+    assert store.quarantined_chunks.get("0") == "oom_floor"
+    assert any(lo <= PULSE_T < hi for lo, hi, _, _ in hits), \
+        "the clean chunks must still find the pulse"
+    report = audit_run(str(tmp_path), store.fingerprint, root="survey")
+    assert report["ok"], report["issues"]
+    worst = [t["to"] for t in engine.transitions]
+    assert "CRITICAL" in worst and engine.verdict == "OK"
+    # exact resume: the quarantined chunk is done-with-reason, so a
+    # resumed session has nothing left to search
+    with plan.armed():  # would fire again if chunk 0 were re-dispatched
+        fired_before = plan.fired()
+        search_by_chunks(survey_file, output_dir=str(tmp_path),
+                         **SEARCH_KW)
+    assert plan.fired() == fired_before
+
+
+def test_stream_search_oom_byte_identical(rng):
+    from pulsarutils_tpu.parallel.stream import stream_search
+
+    chunks = [(s, np.abs(rng.normal(0, 1, (32, 2048))
+                         ).astype(np.float32) + 5)
+              for s in (0, 1024, 2048)]
+    res0, _ = stream_search(list(chunks), 100, 200, *GEOM)
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="oom", chunks=(0,),
+                                times=1)])
+    with plan.armed():
+        res1, _ = stream_search(list(chunks), 100, 200, *GEOM)
+    assert plan.fired() == 1
+    assert len(res0) == len(res1)
+    for (i0, t0), (i1, t1) in zip(res0, res1):
+        assert i0 == i1
+        for col in t0.colnames:
+            assert np.array_equal(np.asarray(t0[col]),
+                                  np.asarray(t1[col])), col
+
+
+def test_mesh_fused_hybrid_oom_unfuses_bitwise():
+    """The unfuse rung: an OOM at the fused mesh dispatch drops to the
+    two-stage composition, whose result is pinned bit-identical."""
+    import jax
+
+    from pulsarutils_tpu.models.simulate import simulate_test_data
+    from pulsarutils_tpu.parallel.mesh import make_mesh
+    from pulsarutils_tpu.parallel.sharded_fdmt import sharded_hybrid_search
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    array, header = simulate_test_data(150, nchan=64, nsamples=4096,
+                                       signal=2.0, noise=0.4, rng=51)
+    args = (100, 200.0, header["fbottom"], header["bandwidth"],
+            header["tsamp"])
+    mesh = make_mesh((4, 2), ("dm", "chan"))
+    t_clean = sharded_hybrid_search(array, *args, mesh=mesh)
+    plan = FaultPlan([FaultSpec(site="mesh", kind="oom", times=1)])
+    before = _csum("putpu_oom_events_total")
+    with plan.armed():
+        t_oom = sharded_hybrid_search(array, *args, mesh=mesh)
+    assert plan.fired() == 1
+    assert _csum("putpu_oom_events_total") > before
+    assert ladder.unfuse_engaged()
+    for col in t_clean.colnames:
+        assert np.array_equal(np.asarray(t_clean[col]),
+                              np.asarray(t_oom[col])), col
+    assert t_clean.meta == t_oom.meta
+    # the engaged level keeps later chunks on the two-stage path —
+    # still identical (fused == unfused is the PR 2 contract)
+    t_next = sharded_hybrid_search(array, *args, mesh=mesh)
+    assert np.array_equal(np.asarray(t_clean["snr"]),
+                          np.asarray(t_next["snr"]))
+
+
+@pytest.mark.parametrize("kernel", ["roll", "gather"])
+@pytest.mark.parametrize("packed", [False, True])
+def test_beam_batcher_oom_splits_byte_identical(kernel, packed, rng):
+    """The halve_batch rung (satellite): a forced mid-batch OOM splits
+    N beams into two half-batches whose per-beam tables are
+    byte-identical to the unsplit dispatch — both formulations, packed
+    and float inputs."""
+    from pulsarutils_tpu.beams.batcher import BeamBatcher
+    from pulsarutils_tpu.io.lowbit import pack_numpy
+
+    nchan, nsamps, nbits = 32, 2048, 2
+    dms = np.linspace(100.0, 200.0, 16)
+    if packed:
+        def beam(seed):
+            codes = np.random.default_rng(seed).integers(
+                0, 1 << nbits, (nchan, nsamps))
+            return np.stack([pack_numpy(codes[::-1, t], nbits)
+                             for t in range(nsamps)])
+        batcher = BeamBatcher(nchan, nsamps, dms, *GEOM, kernel=kernel,
+                              packed=(nbits, True))
+    else:
+        def beam(seed):
+            return np.abs(np.random.default_rng(seed).normal(
+                0, 1, (nchan, nsamps))).astype(np.float32) + 5
+        batcher = BeamBatcher(nchan, nsamps, dms, *GEOM, kernel=kernel)
+    blocks = [beam(s) for s in range(4)]
+    unsplit = batcher.search(blocks)
+    plan = FaultPlan([FaultSpec(site="beams", kind="oom", times=1)])
+    before = _csum("putpu_oom_ladder_steps_total")
+    with plan.armed():
+        split = batcher.search(blocks)
+    ladder.reset()
+    assert plan.fired() == 1
+    assert _csum("putpu_oom_ladder_steps_total") > before
+    assert len(split) == len(unsplit) == 4
+    for tu, ts in zip(unsplit, split):
+        for col in tu.colnames:
+            assert np.array_equal(np.asarray(tu[col]),
+                                  np.asarray(ts[col])), col
+
+
+def test_beam_batcher_preflight_cap(monkeypatch, rng):
+    """Admission preflight: with a tiny budget the batcher splits the
+    dispatch up front (no OOM needed), results unchanged."""
+    from pulsarutils_tpu.beams.batcher import BeamBatcher
+
+    nchan, nsamps = 32, 2048
+    dms = np.linspace(100.0, 200.0, 16)
+    batcher = BeamBatcher(nchan, nsamps, dms, *GEOM, kernel="roll")
+    blocks = [np.abs(rng.normal(0, 1, (nchan, nsamps))
+                     ).astype(np.float32) + 5 for _ in range(3)]
+    free = batcher.search(blocks)
+    monkeypatch.setenv(membudget.MEM_LIMIT_ENV, "1000000")
+    assert batcher.max_batch() == 1
+    capped = batcher.search(blocks)
+    for tf, tc in zip(free, capped):
+        for col in tf.colnames:
+            assert np.array_equal(np.asarray(tf[col]),
+                                  np.asarray(tc[col])), col
+
+
+# ---------------------------------------------------------------------------
+# health + report surfacing
+# ---------------------------------------------------------------------------
+
+def test_health_engine_oom_conditions():
+    from pulsarutils_tpu.obs.health import HealthEngine
+
+    engine = HealthEngine(recover_after=2)
+    assert engine.update(0, oom_events=1) == "DEGRADED"
+    assert "memory_pressure" in engine.reasons()
+    assert engine.update(1, oom_floor=True) == "CRITICAL"
+    assert "oom_floor" in engine.reasons()
+    engine.update(2)
+    assert engine.update(3) == "OK", "conditions must decay on clean chunks"
+
+
+def test_report_memory_pressure_section():
+    from pulsarutils_tpu.obs.report import build_report, render_markdown
+
+    rec = build_report(meta={"root": "x"}, metrics=[
+        {"name": "putpu_oom_events_total", "type": "counter",
+         "labels": {"surface": "direct_sweep"}, "value": 3}])
+    md = render_markdown(rec)
+    assert "## Memory pressure" in md
+    assert "oom_events_total{surface=direct_sweep}" in md
+    # absence stated
+    md_clean = render_markdown(build_report(meta={"root": "x"},
+                                            metrics=[]))
+    assert "No memory pressure" in md_clean
+
+
+# ---------------------------------------------------------------------------
+# service admission control
+# ---------------------------------------------------------------------------
+
+def test_service_admission_caps_cobatch(tmp_path, monkeypatch):
+    """Two same-geometry tenants under a tiny memory budget: both jobs
+    are accepted and finish, but each runs in its own capped batch
+    (batch_group of 1) instead of being co-batched into an OOM."""
+    import time as _time
+
+    from pulsarutils_tpu.beams.service import SurveyService
+
+    rng = np.random.default_rng(3)
+    paths = []
+    for i in range(2):
+        array = np.abs(rng.normal(0, 0.5, (32, 8192))) + 20.0
+        array[:, 4000] += 4.0
+        array = disperse_array(array, 150, 1200., 200., TSAMP)
+        header = {"bandwidth": 200., "fbottom": 1200., "nchans": 32,
+                  "nsamples": 8192, "tsamp": TSAMP, "foff": 200. / 32}
+        p = str(tmp_path / f"beam{i}.fil")
+        write_simulated_filterbank(p, array, header, descending=True)
+        paths.append(p)
+    monkeypatch.setenv(membudget.MEM_LIMIT_ENV, "1000000")
+    before = _csum("putpu_oom_admission_capped_total")
+    with SurveyService(str(tmp_path / "out"),
+                       batch_window_s=0.3) as service:
+        ids = [service.submit({"fname": p, "dmmin": 100.0,
+                               "dmmax": 200.0, "snr_threshold": 6.5})
+               for p in paths]
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            docs = [service.get(j) for j in ids]
+            if all(d["state"] in ("done", "failed") for d in docs):
+                break
+            _time.sleep(0.2)
+    docs = [d for d in docs]
+    assert [d["state"] for d in docs] == ["done", "done"], docs
+    assert all(len(d["batch_group"]) == 1 for d in docs), \
+        "admission control must cap the co-batch at the budgeted width"
+    assert _csum("putpu_oom_admission_capped_total") > before
+
+
+# ---------------------------------------------------------------------------
+# fleet: wire retries, budget-sized leases, too_large re-shard
+# ---------------------------------------------------------------------------
+
+def test_post_json_retry_counts_and_gives_up(monkeypatch):
+    from pulsarutils_tpu.fleet import protocol
+
+    calls = {"n": 0}
+
+    def flaky(url, doc, timeout=10.0):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("peer reset")
+        return {"ok": True}
+
+    monkeypatch.setattr(protocol, "post_json", flaky)
+    before = _csum("putpu_fleet_wire_retries_total")
+    assert protocol.post_json_retry("http://x", {}, backoff_s=0.0,
+                                    jitter_s=0.0) == {"ok": True}
+    assert calls["n"] == 3
+    assert _csum("putpu_fleet_wire_retries_total") == before + 2
+
+    # an HTTP status error is an answer, not weather: no retry
+    def rejected(url, doc, timeout=10.0):
+        calls["n"] += 1
+        raise ValueError("http://x -> HTTP 400: bad")
+
+    calls["n"] = 0
+    monkeypatch.setattr(protocol, "post_json", rejected)
+    with pytest.raises(ValueError):
+        protocol.post_json_retry("http://x", {}, backoff_s=0.0)
+    assert calls["n"] == 1
+
+    # a persistently dead link propagates the transport error
+    def dead(url, doc, timeout=10.0):
+        raise ConnectionRefusedError("nope")
+
+    monkeypatch.setattr(protocol, "post_json", dead)
+    with pytest.raises(ConnectionRefusedError):
+        protocol.post_json_retry("http://x", {}, retries=1,
+                                 backoff_s=0.0, jitter_s=0.0)
+
+
+def test_fleet_too_large_release_reshards_over_http(survey_file,
+                                                    tmp_path):
+    """Over the real wire: a register carries the worker's memory
+    budget, an over-budget worker's too_large release makes the
+    coordinator split the unit smaller (without draining the worker),
+    budget-sized grants re-shard at grant time, and a real worker then
+    finishes the survey byte-identical to the single-process run."""
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.protocol import post_json
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    _, store = search_by_chunks(survey_file,
+                                output_dir=str(tmp_path / "single"),
+                                **SEARCH_KW)
+    base = _snapshot(str(tmp_path / "single"), store.fingerprint)
+
+    outdir = str(tmp_path / "fleet")
+    coordinator = FleetCoordinator(outdir, chunks_per_unit=3,
+                                   auto_sweep=False)
+    server = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        config = {k: v for k, v in SEARCH_KW.items()
+                  if k not in ("make_plots", "progress")}
+        coordinator.add_survey([survey_file], **config)
+        chunk_est = coordinator._files[
+            os.path.abspath(survey_file)]["chunk_est_bytes"]
+        assert chunk_est > 0
+
+        # a worker with no budget gets the whole 3-chunk unit...
+        post_json(url + "/fleet/register",
+                  {"healthz_url": None, "worker": "big"})
+        resp = post_json(url + "/fleet/lease",
+                         {"worker": "big", "max_units": 1})
+        (lease,) = resp["leases"]
+        assert len(lease["chunks"]) == 3
+        # ...and releases it too_large: the coordinator re-shards it
+        before = _csum("putpu_fleet_units_resharded_total")
+        post_json(url + "/fleet/release",
+                  {"worker": "big", "leases": [lease["lease"]],
+                   "reason": "too_large"})
+        assert _csum("putpu_fleet_units_resharded_total") == before + 1
+        sizes = sorted(len(u["chunks"]) for u in (
+            unit.doc() for unit in coordinator._units.values())
+            if u["state"] == "pending")
+        assert sizes == [1, 2], \
+            "the 3-chunk unit must be re-sharded into smaller units"
+        # too_large does NOT drain the worker: it can still lease
+        resp = post_json(url + "/fleet/lease",
+                         {"worker": "big", "max_units": 1})
+        assert resp["denied"] is None and resp["leases"]
+        post_json(url + "/fleet/release",
+                  {"worker": "big",
+                   "leases": [le["lease"] for le in resp["leases"]],
+                   "reason": "handover"})
+
+        # a budget-reporting worker's grants are sized at grant time
+        post_json(url + "/fleet/register",
+                  {"healthz_url": None, "worker": "small",
+                   "mem_budget_bytes": int(chunk_est * 1.5)})
+        doc = coordinator.workers_doc()
+        small = next(w for w in doc["workers"]
+                     if w["worker"] == "small")
+        assert small["mem_budget_bytes"] == int(chunk_est * 1.5)
+        resp = post_json(url + "/fleet/lease",
+                         {"worker": "small", "max_units": 1})
+        (lease,) = resp["leases"]
+        assert len(lease["chunks"]) == 1, \
+            "the lease must be sized to the reported budget"
+        post_json(url + "/fleet/release",
+                  {"worker": "small", "leases": [lease["lease"]],
+                   "reason": "handover"})
+
+        # a 2-worker fleet — with a transient OOM landing mid-survey —
+        # finishes the re-sharded survey byte-identical to the
+        # single-process run (the acceptance contract: the worker's
+        # own degradation ladder recovers, no steal, no divergence)
+        import threading
+
+        plan = FaultPlan([FaultSpec(site="dispatch", kind="oom",
+                                    chunks=(0,), times=1)])
+        workers = [FleetWorker(url, http_port=None) for _ in range(2)]
+        with plan.armed():
+            threads = [threading.Thread(target=w.run,
+                                        kwargs={"max_idle_s": 30})
+                       for w in workers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+        assert plan.fired() == 1
+        assert coordinator.survey_done
+        assert _snapshot(outdir, store.fingerprint) == base
+    finally:
+        server.close()
+        coordinator.close()
+
+
+def test_register_rejects_bogus_budget(tmp_path):
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+
+    coordinator = FleetCoordinator(str(tmp_path), auto_sweep=False)
+    try:
+        with pytest.raises(ValueError, match="mem_budget_bytes"):
+            coordinator.register({"healthz_url": None,
+                                  "mem_budget_bytes": -5})
+    finally:
+        coordinator.close()

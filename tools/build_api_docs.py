@@ -51,6 +51,8 @@ MODULES = [
     "pulsarutils_tpu.fleet.protocol",
     "pulsarutils_tpu.fleet.coordinator",
     "pulsarutils_tpu.fleet.worker",
+    "pulsarutils_tpu.resilience.memory_budget",
+    "pulsarutils_tpu.resilience.ladder",
     "pulsarutils_tpu.io.sigproc",
     "pulsarutils_tpu.io.lowbit",
     "pulsarutils_tpu.io.candidates",

@@ -193,7 +193,26 @@ def _fault_classes():
             site="corrupt", kind="dead_channels", chunks=(NOISE_CHUNK,),
             frac=0.1, times=1)],
             {}, None),
+        # -- resource exhaustion (ISSUE 12): transient OOM descends the
+        # degradation ladder (split trial passes) and recovers with
+        # candidates byte-identical; the chunks searched AFTER the
+        # descent run degraded too — the identity contract covers them
+        "oom_transient": (True, [FaultSpec(
+            site="dispatch", kind="oom", chunks=(NOISE_CHUNK,),
+            times=1)],
+            {}, None),
         # -- unrecoverable: contained, quarantined, audited ------------
+        # persistent floor-OOM (ISSUE 12): every device rung OOMs AND
+        # the numpy reliability floor itself raises MemoryError (the
+        # "host" site) — the chunk must land in the quarantine
+        # manifest as oom_floor with the audit clean, never wedge or
+        # kill the survey
+        "oom_floor": (False, [
+            FaultSpec(site="dispatch", kind="oom", chunks=(NOISE_CHUNK,),
+                      times=None),
+            FaultSpec(site="host", kind="oom", chunks=(NOISE_CHUNK,),
+                      times=None)],
+            {}, {NOISE_CHUNK}),
         "hard_corrupt": (False, [FaultSpec(
             site="corrupt", kind="nan", chunks=(NOISE_CHUNK,), frac=0.9,
             times=1)],
@@ -458,13 +477,54 @@ def _fleet_class(name, base_dir, path, baseline, fingerprint, log,
     return rec
 
 
+def _fleet_oom_class(base_dir, path, baseline, fingerprint, log):
+    """**oom_worker** (ISSUE 12): a worker whose first search dispatch
+    raises an injected RESOURCE_EXHAUSTED.  The worker's in-process
+    degradation ladder must recover (no steal, no requeue storm) and
+    finish the survey with outputs byte-identical to the
+    single-process baseline."""
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    outdir = os.path.join(base_dir, "oom_worker")
+    t0 = time.time()
+    coordinator = FleetCoordinator(
+        outdir, lease_ttl_s=FLEET_LEASE_TTL_S, chunks_per_unit=1,
+        probe_interval_s=0.5, auto_sweep=True)
+    server = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{server.port}"
+    coordinator.add_survey([path], **{k: v for k, v in SEARCH_KW.items()
+                                      if k not in ("make_plots",
+                                                   "progress")})
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="oom",
+                                chunks=(NOISE_CHUNK,), times=1)])
+    try:
+        with plan.armed():
+            worker = FleetWorker(url, http_port=None)
+            worker.run(max_idle_s=60)
+        done = coordinator.survey_done
+    finally:
+        server.close()
+        coordinator.close()
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    return {"recoverable": True, "fired": plan.fired(),
+            "survey_done": done, "byte_identical": not diffs,
+            "diffs": diffs, "wall_s": round(time.time() - t0, 2),
+            "ok": bool(plan.fired()) and done and not diffs}
+
+
 def run_fleet_drill(quick=False, log=print, workdir=None, keep=False):
-    """The fleet chaos classes (ISSUE 9): killed_worker (SIGKILL while
-    holding a lease) and wedged_worker (hung far past the lease TTL).
-    Both must complete the survey byte-identical to the single-process
-    baseline via lease expiry + ledger-checked requeue.  Slow (spawns
-    real worker processes); runs as a ``slow``+``chaos`` pytest and via
-    ``--fleet`` here — config 14 gates the fast in-process equivalent.
+    """The fleet chaos classes: killed_worker (SIGKILL while holding a
+    lease, ISSUE 9), wedged_worker (hung far past the lease TTL, ISSUE
+    9) and oom_worker (injected RESOURCE_EXHAUSTED recovered by the
+    worker's own degradation ladder, ISSUE 12).  All must complete the
+    survey byte-identical to the single-process baseline.  Slow
+    (spawns real worker processes); runs as a ``slow``+``chaos``
+    pytest and via ``--fleet`` here — config 14 gates the fast
+    in-process equivalent.
     """
     t_start = time.time()
     base_dir = workdir or tempfile.mkdtemp(prefix="chaos_fleet_")
@@ -489,6 +549,11 @@ def run_fleet_drill(quick=False, log=print, workdir=None, keep=False):
                                      fingerprint, log, kill)
         log(f"fleet drill: class {name}: "
             f"{'PASS' if classes[name]['ok'] else 'FAIL ' + str(classes[name])}")
+    log("fleet drill: class oom_worker")
+    classes["oom_worker"] = _fleet_oom_class(base_dir, path, baseline,
+                                             fingerprint, log)
+    log(f"fleet drill: class oom_worker: "
+        f"{'PASS' if classes['oom_worker']['ok'] else 'FAIL ' + str(classes['oom_worker'])}")
 
     result = {
         "n_classes": len(classes),

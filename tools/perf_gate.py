@@ -62,9 +62,12 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: diverges or the fleet fails to finish; 15: the packed-low-bit
 #: vs host-unpack streaming A/B — its value drops to 0.0 when any
 #: per-chunk table byte diverges or the uploaded-bytes ratio falls
-#: below 8x; all eight run in tier-1-scale time)
+#: below 8x; 16: the constrained-memory A/B — its value drops to 0.0
+#: when an OOM-forced degraded run's candidates/ledger diverge by a
+#: byte, no ladder descent fires, or the health verdict fails to
+#: recover to OK; all nine run in tier-1-scale time)
 DEFAULT_BASELINE = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
-DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15)
+DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15, 16)
 
 #: the committed tune-cache artifact the gate version-checks (the
 #: snapshot-schema rule of PR 5, applied to tuner measurements: a
@@ -99,9 +102,14 @@ DEFAULT_TUNE_ARTIFACT = os.path.join(REPO, "TUNE_cpu.json")
 #: streaming on CPU, where "upload" is a memcpy): its gated signal is
 #: the forced 0.0 on a per-chunk table byte divergence or a
 #: bytes-uploaded ratio below 8x, so the wall-clock bound applies.
+#: Config 16 is the constrained-memory quotient-of-walls (ISSUE 12):
+#: unconstrained vs one-ladder-descent degraded run of the same
+#: survey; the gated signal is the forced 0.0 on byte divergence /
+#: missing descent / unrecovered health, so it takes the wall-clock
+#: bound too.
 #: Config 10 stays TIGHT: canary recall is deterministic, not jittery.
 DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75, 13: 0.75,
-                          14: 0.75, 15: 0.75}
+                          14: 0.75, 15: 0.75, 16: 0.75}
 
 
 def run_suite(configs, preset, out_path):
