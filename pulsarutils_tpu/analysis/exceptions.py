@@ -70,6 +70,8 @@ CONTAINMENT_SEAMS = {
     # thread must survive to run the next batch (jax errors share no
     # base class here either)
     ("beams/service.py", "SurveyService._run_batch"),
+    # one failed periodicity job likewise (ISSUE 13)
+    ("beams/service.py", "SurveyService._run_periodicity"),
     # a poisoned leased unit reports its error string and the
     # coordinator requeues (bounded by max_attempts); the fleet worker
     # must survive to lease the next unit (jax errors again) — the
@@ -77,6 +79,13 @@ CONTAINMENT_SEAMS = {
     # handlers ride the already-seamed obs/server do_GET/do_POST, and
     # the drain path catches only (OSError, ValueError) narrowly)
     ("fleet/worker.py", "FleetWorker._run_unit"),
+    # the periodicity trial sweep's device->host fallback (ISSUE 13):
+    # re-raises (ValueError, TypeError) first, then degrades a failed
+    # jax dispatch to the numpy reference path — the same ladder-floor
+    # convention as _search_with_fallback (jax errors, no base class);
+    # the driver's report writer shares search_by_chunks' never-fatal
+    # observability rule
+    ("periodicity/driver.py", "periodicity_search"),
     # -- CLI report amendment: observability never fails the run -----------
     ("cli/search_main.py", "main"),
 }

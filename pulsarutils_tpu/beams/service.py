@@ -58,12 +58,30 @@ _FORWARD_KEYS = ("snr_threshold", "max_chunks", "chunk_length",
                  "new_sample_time", "canary_rate", "veto_frac",
                  "max_real_beams")
 
+#: keys a ``workload="periodicity"`` job may carry on top of the shared
+#: ones (ISSUE 13); ``period_sigma_threshold`` maps onto the driver's
+#: ``sigma_threshold``
+_PERIOD_KEYS = ("accel_max", "n_accel", "period_sigma_threshold")
 
-def JobSpec(fname, dmmin, dmmax, **knobs):
+#: keys only the batched multibeam runner understands — rejected
+#: explicitly on periodicity jobs (silently dropping a requested knob
+#: would misrepresent what ran, the ISSUE 9 add_job rule)
+_MULTIBEAM_ONLY = ("canary_rate", "veto_frac", "max_real_beams",
+                   "max_chunks")
+
+WORKLOADS = ("single_pulse", "periodicity")
+
+
+def JobSpec(fname, dmmin, dmmax, workload=None, **knobs):
     """Normalise a job spec dict (the POST /jobs body shape)."""
     spec = {"fname": str(fname), "dmmin": float(dmmin),
             "dmmax": float(dmmax)}
-    for key in _FORWARD_KEYS:
+    if workload is not None and str(workload) != "single_pulse":
+        # the default workload is normalised AWAY: an explicit
+        # "single_pulse" must produce the same spec (and the same
+        # co-batching geometry tag) as omitting the key
+        spec["workload"] = str(workload)
+    for key in (*_FORWARD_KEYS, *_PERIOD_KEYS):
         if key in knobs and knobs[key] is not None:
             spec[key] = knobs[key]
     return spec
@@ -79,14 +97,40 @@ def validate_spec(spec):
     FleetCoordinator.add_job` — a spec either deployment accepts is
     valid in the other, so routing jobs from a single-host service to
     a worker fleet is a deployment decision, not a format migration.
+
+    ``workload`` selects the job type (ISSUE 13): ``"single_pulse"``
+    (default — the batched multibeam run) or ``"periodicity"`` (the
+    full-observation acceleration search,
+    :func:`~pulsarutils_tpu.periodicity.driver.periodicity_search`).
+    Periodicity jobs may carry :data:`_PERIOD_KEYS`; multibeam-only
+    knobs on them — and periodicity-only knobs on single-pulse jobs —
+    are rejected, not dropped.
     """
     if not isinstance(spec, dict):
         raise ValueError("job spec must be a JSON object")
     missing = {"fname", "dmmin", "dmmax"} - set(spec)
     if missing:
         raise ValueError(f"job spec missing keys: {sorted(missing)}")
+    workload = spec.get("workload", "single_pulse")
+    if workload not in WORKLOADS:
+        raise ValueError(f"workload={workload!r}: expected one of "
+                         f"{WORKLOADS}")
+    if workload == "periodicity":
+        bad = sorted(set(spec) & set(_MULTIBEAM_ONLY))
+        if bad:
+            raise ValueError(
+                f"job spec keys {bad} are multibeam-only knobs a "
+                "periodicity job does not run")
+        if float(spec.get("accel_max", 0.0)) < 0:
+            raise ValueError("accel_max must be >= 0")
+    else:
+        bad = sorted(set(spec) & set(_PERIOD_KEYS))
+        if bad:
+            raise ValueError(
+                f"job spec keys {bad} require workload='periodicity'")
     spec = JobSpec(**{k: spec[k] for k in
-                      ({"fname", "dmmin", "dmmax"} | set(_FORWARD_KEYS))
+                      ({"fname", "dmmin", "dmmax", "workload"}
+                       | set(_FORWARD_KEYS) | set(_PERIOD_KEYS))
                       & set(spec)})
     if not os.path.exists(spec["fname"]):
         raise ValueError(f"no such file: {spec['fname']}")
@@ -117,6 +161,7 @@ class _Job:
         self.chunks_total = None
         self.hits = 0
         self.coincidence = None
+        self.period = None      # periodicity-job summary (ISSUE 13)
         self.batch_group = None  # job ids co-batched with this one
         self.cancel_event = threading.Event()
         self.health = HealthEngine()
@@ -135,6 +180,7 @@ class _Job:
             "chunks_total": self.chunks_total,
             "hits": self.hits,
             "coincidence": self.coincidence,
+            "period": self.period,
             "batch_group": self.batch_group,
             "health": {"status": self.health.verdict,
                        "reasons": self.health.reasons()},
@@ -343,6 +389,14 @@ class SurveyService:
                 jtag = job.geom_tag  # cached at submit: no disk under lock
                 if tag is None:
                     tag = jtag
+                    if job.spec.get("workload") == "periodicity":
+                        # a periodicity job accumulates ONE file's full
+                        # observation — it runs alone (the geometry tag
+                        # already keeps single-pulse tenants out of its
+                        # batch; this keeps other periodicity jobs out
+                        # too)
+                        batch.append(job_id)
+                        break
                 if jtag != tag:
                     continue
                 # one job per FILE per batch: two jobs over the same
@@ -396,12 +450,77 @@ class SurveyService:
                 if self._queue:
                     self._wake.set()
 
+    def _run_periodicity(self, job):
+        """One periodicity job through the full-observation driver
+        (ISSUE 13).  Broad containment mirrors ``_run_batch``: one
+        failed job must not kill the service worker (jax errors share
+        no base class) — a reviewed seam."""
+        from ..periodicity.driver import periodicity_search
+
+        spec = job.spec
+
+        def chunk_cb(_istart):
+            with self._lock:
+                job.chunks_done += 1
+            _metrics.counter("putpu_job_chunks_done_total",
+                             job=job.id).inc()
+
+        kwargs = {k: spec[k] for k in ("accel_max", "n_accel",
+                                       "snr_threshold", "chunk_length",
+                                       "new_sample_time") if k in spec}
+        if "period_sigma_threshold" in spec:
+            kwargs["sigma_threshold"] = spec["period_sigma_threshold"]
+        try:
+            res = periodicity_search(
+                spec["fname"], spec["dmmin"], spec["dmmax"],
+                output_dir=self.output_dir, resume=self.resume,
+                cancel_cb=job.cancel_event.is_set, chunk_cb=chunk_cb,
+                health=job.health, progress=False, **kwargs)
+        except Exception as exc:  # one bad job must not kill the service worker
+            logger.error("periodicity job %s failed: %r", job.id, exc)
+            with self._lock:
+                self._finish_locked(job, FAILED, error=repr(exc))
+            return
+        cands = res["candidates"] or []
+        with self._lock:
+            job.hits = len(cands)
+            job.chunks_total = (len(res["store"].done_chunks)
+                                if self.resume else job.chunks_done)
+            job.period = {
+                "complete": res["complete"],
+                "candidates_path": res["candidates_path"],
+                "kept": len(cands),
+                "sift": res["sift"],
+                "top": [{k: c.get(k) for k in
+                         ("dm", "accel", "freq", "sigma", "nharm")}
+                        for c in cands[:5]],
+            }
+            _metrics.counter("putpu_job_hits_total",
+                             job=job.id).inc(job.hits)
+            if res["complete"]:
+                state, error = DONE, None
+            elif job.cancel_event.is_set():
+                state, error = CANCELLED, None
+            else:
+                # incomplete WITHOUT a cancel (chunks quarantined away
+                # mid-re-search, snapshot unrecoverable): a terminal
+                # "done" here would tell the client its candidates
+                # exist when no artifact was written — surface it
+                state, error = FAILED, ("periodicity job ended "
+                                        "incomplete; resubmit to resume")
+            self._finish_locked(job, state, error=error)
+        logger.info("periodicity job %s finished: %s (%d candidates)",
+                    job.id, job.state, len(cands))
+
     def _run_batch(self, batch):
         from .multibeam import multibeam_search
 
         with self._lock:
             jobs = [self._jobs[j] for j in batch]
         spec = jobs[0].spec
+        if spec.get("workload") == "periodicity":
+            self._run_periodicity(jobs[0])
+            return
         logger.info("job batch %s: %d tenant(s) in one batched run",
                     batch, len(jobs))
 

@@ -23,6 +23,10 @@ site       seam                                                 kinds
 ``host``   the numpy-fallback rung of the chunk ladder          ``oom``
 ``persist````CandidateStore.save_candidate``                    ``error``
 ``fleet``  ``FleetWorker._run_unit`` (per leased unit; ISSUE 9) ``error``, ``hang``
+``period`` the periodicity trial-sweep device dispatch          ``error``, ``hang``, ``oom``
+           (``periodicity/driver.py``, ISSUE 13) — any raise
+           degrades the sweep to its numpy reference path, so
+           the chaos class proves candidates survive it
 ========== ==================================================== ==========================
 
 ``kind="oom"`` (ISSUE 12) raises a *real* ``XlaRuntimeError``-shaped

@@ -180,13 +180,43 @@ class FleetCoordinator:
         from ..pipeline.search_pipeline import plan_survey, search_by_chunks
 
         config = protocol.clean_search_config(config)
+        # the periodicity workload (ISSUE 13): plan under the SAME
+        # fingerprint_extra the worker's periodicity_search will use,
+        # and shard each file as ONE unit — accumulation needs the
+        # whole observation on one worker, and a chunk-subset lease
+        # would hand different workers halves of one plane
+        workload = config.get("workload", "single_pulse")
+        from ..beams.service import WORKLOADS
+
+        if workload not in WORKLOADS:
+            # the service validates this in validate_spec; the fleet's
+            # own front door must too, or a typoed workload silently
+            # runs a single-pulse survey with no periodicity artifact
+            # and no error anywhere
+            raise ValueError(f"workload={workload!r}: expected one of "
+                             f"{WORKLOADS}")
+        period_extra = None
+        if workload == "periodicity":
+            period_extra = {"workload": "periodicity",
+                            "accel_max": float(config.get("accel_max",
+                                                          0.0))}
+        else:
+            # periodicity-only keys on a single-pulse config would ride
+            # the lease into search_by_chunks (which has no such
+            # parameters) and fail every unit — reject at intake, the
+            # validate_spec rule applied to the fleet's own front door
+            bad = sorted(set(config) & {"accel_max", "n_accel"})
+            if bad:
+                raise ValueError(
+                    f"search config keys {bad} require "
+                    "workload='periodicity'")
         # plan with the WORKER's effective defaults: keys the lease
         # omits resolve from search_by_chunks' own signature, never
         # from plan_survey's — so a future default edit in the driver
         # cannot silently fork coordinator and worker onto different
         # fingerprints (they'd disagree on every completion)
         plan_params = set(inspect.signature(plan_survey).parameters) \
-            - {"fname"}
+            - {"fname", "fingerprint_extra"}  # coordinator-owned (ISSUE 13)
         driver_defaults = {
             k: p.default for k, p in
             inspect.signature(search_by_chunks).parameters.items()
@@ -194,15 +224,38 @@ class FleetCoordinator:
         plan_config = dict(
             driver_defaults,
             **{k: v for k, v in config.items() if k in plan_params})
+        if workload == "periodicity":
+            # the periodicity driver's transport always plans with the
+            # driver defaults for the per-chunk rescue-seam knobs (the
+            # full-observation stage replaces that seam, and
+            # periodicity_search rejects the knobs outright) — the
+            # coordinator must fingerprint identically or every unit
+            # completion would read the wrong ledger
+            plan_config["period_search"] = driver_defaults.get(
+                "period_search", False)
+            plan_config["period_sigma_threshold"] = driver_defaults.get(
+                "period_sigma_threshold", 8.0)
         from ..resilience.memory_budget import estimate_chunk_bytes
 
         planned = []
         for fname in fnames:
             fname = os.path.abspath(str(fname))
-            sp = plan_survey(fname, **plan_config)
+            sp = plan_survey(fname, fingerprint_extra=period_extra,
+                             **plan_config)
             done = self._read_ledger_done(sp["fingerprint"]) \
                 if self.resume else set()
             starts = [s for s in sp["chunk_starts"] if s not in done]
+            artifact = None
+            if workload == "periodicity":
+                artifact = os.path.join(
+                    self.output_dir,
+                    f"period_cands_{sp['root']}_{sp['fingerprint']}.npz")
+                if not starts and not os.path.exists(artifact):
+                    # fully-accumulated ledger but no candidates: the
+                    # trial-search stage still owes its artifact —
+                    # shard the (ledger-complete) unit anyway so a
+                    # worker re-runs the sweep from the snapshot
+                    starts = list(sp["chunk_starts"])
             # per-chunk footprint estimate (ISSUE 12): the number the
             # coordinator sizes leases against for budget-reporting
             # workers.  The trial count is the plan's one-trial-per-
@@ -211,10 +264,10 @@ class FleetCoordinator:
             chunk_est = estimate_chunk_bytes(
                 sp["reader"].header["nchans"], t_eff,
                 max(t_eff // 2, 1))
-            planned.append((fname, sp, starts, chunk_est))
+            planned.append((fname, sp, starts, chunk_est, artifact))
         ids = []
         with self._lock:
-            for fname, sp, starts, chunk_est in planned:
+            for fname, sp, starts, chunk_est, artifact in planned:
                 if fname in self._files \
                         and self._files[fname]["fingerprint"] \
                         != sp["fingerprint"]:
@@ -224,21 +277,25 @@ class FleetCoordinator:
                         "per file")
                 self._files[fname] = {
                     "fingerprint": sp["fingerprint"], "config": config,
-                    "root": sp["root"],
+                    "root": sp["root"], "workload": workload,
+                    "artifact": artifact,
                     "chunks_total": len(sp["chunk_starts"]),
                     "chunk_starts": list(sp["chunk_starts"]),
                     "chunk_est_bytes": int(chunk_est)}
-                for i in range(0, len(starts), self.chunks_per_unit):
+                per_unit = (max(len(starts), 1)
+                            if workload == "periodicity"
+                            else self.chunks_per_unit)
+                for i in range(0, len(starts), per_unit):
                     self._seq["unit"] += 1
                     unit = _Unit(f"u{self._seq['unit']}", fname,
-                                 starts[i:i + self.chunks_per_unit])
+                                 starts[i:i + per_unit])
                     self._units[unit.id] = unit
                     self._pending.append(unit.id)
                     ids.append(unit.id)
                 logger.info(
                     "fleet: sharded %s into %d unit(s) (%d of %d chunks "
                     "pending, fingerprint %s)", os.path.basename(fname),
-                    -(-len(starts) // self.chunks_per_unit), len(starts),
+                    -(-len(starts) // per_unit), len(starts),
                     len(sp["chunk_starts"]), sp["fingerprint"])
             self._update_gauges_locked()
         return ids
@@ -293,11 +350,24 @@ class FleetCoordinator:
         return {int(c) for c in done if isinstance(c, int)}
 
     def _ledger_remaining(self, unit, done_cache):
-        fingerprint = self._files[unit.fname]["fingerprint"]
+        rec = self._files[unit.fname]
+        fingerprint = rec["fingerprint"]
         if fingerprint not in done_cache:
             done_cache[fingerprint] = self._read_ledger_done(fingerprint)
         done = done_cache[fingerprint]
-        return tuple(c for c in unit.chunks if c not in done)
+        remaining = tuple(c for c in unit.chunks if c not in done)
+        if not remaining and rec.get("artifact") \
+                and not os.path.exists(rec["artifact"]):
+            # periodicity (ISSUE 13): the chunk ledger records only the
+            # accumulation transport — the persisted candidates npz is
+            # the completion record of the trial-search/sift/fold
+            # stages.  A worker that accumulated everything and died
+            # before the sweep must NOT resolve the unit as done, or
+            # the job finishes with no candidates; re-leasing it costs
+            # nothing (the driver skips ledger-done chunks and runs
+            # the sweep from the snapshot).
+            return tuple(unit.chunks)
+        return remaining
 
     # -- protocol handlers (the obs server routes /fleet/ POSTs here) --------
 
@@ -403,6 +473,13 @@ class FleetCoordinator:
         ``None`` = no budget reported / no estimate, size by
         ``chunks_per_unit`` alone (the pre-ISSUE-12 behaviour)."""
         if worker.mem_budget is None:
+            return None
+        if self._files[unit.fname].get("workload") == "periodicity":
+            # a periodicity unit is the whole observation by design:
+            # the worker searches its chunks sequentially (one chunk
+            # resident at a time), so the per-chunk floor — not the
+            # unit size — is what must fit, and splitting the unit
+            # would split the accumulation plane across workers
             return None
         per = self._files[unit.fname].get("chunk_est_bytes")
         if not per:
@@ -576,7 +653,12 @@ class FleetCoordinator:
                 if lease is None or lease.worker_id != worker_id:
                     continue
                 unit = self._units[lease.unit_id]
-                if too_large and len(unit.chunks) > 1:
+                if too_large and len(unit.chunks) > 1 \
+                        and self._files[unit.fname].get("workload") \
+                        != "periodicity":
+                    # periodicity units are never split (one plane, one
+                    # worker): the requeue below still burns an attempt,
+                    # so an unfittable observation fails bounded
                     self._reshard_unit_locked(
                         unit, (len(unit.chunks) + 1) // 2,
                         f"too_large from {worker_id}")

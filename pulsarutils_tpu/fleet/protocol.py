@@ -89,6 +89,12 @@ SEARCH_KEYS = (
     "quarantine_policy", "overlap_persist", "dispatch_timeout",
     "dispatch_retries", "dispatch_backoff", "persist_retries",
     "persist_backoff",
+    # the periodicity workload rides the lease too (ISSUE 13): the
+    # coordinator plans its fingerprint with the matching
+    # fingerprint_extra and the worker routes the unit to
+    # periodicity_search — the lease stays the single source of truth
+    # for what a unit runs
+    "workload", "accel_max", "n_accel",
 )
 
 

@@ -222,11 +222,35 @@ class FleetWorker:
 
         config = dict(lease["config"])
         config.update(self.search_overrides)
+        workload = config.pop("workload", "single_pulse")
         # deterministic wedge/crash seam for the chaos drill: an armed
         # FaultPlan (PUTPU_FAULT_PLAN survives the subprocess boundary)
         # can hang or fail this worker at unit granularity
         fault_inject.fire("fleet", chunk=lease["chunks"][0])
         try:
+            if workload == "periodicity":
+                # a periodicity lease is the whole observation (the
+                # coordinator shards it as one unit): route it through
+                # the full-observation driver, which runs the SAME
+                # search_by_chunks transport under the SAME
+                # fingerprint_extra the coordinator planned with — the
+                # ledger stays the shared completion record
+                from ..periodicity.driver import periodicity_search
+
+                kwargs = dict(config)
+                accel_max = kwargs.pop("accel_max", 0.0)
+                n_accel = kwargs.pop("n_accel", None)
+                sigma = kwargs.pop("period_sigma_threshold", None)
+                kwargs.pop("period_search", None)
+                periodicity_search(
+                    lease["fname"], accel_max=accel_max,
+                    n_accel=n_accel,
+                    **({"sigma_threshold": sigma}
+                       if sigma is not None else {}),
+                    output_dir=lease["output_dir"], resume=True,
+                    progress=False, health=self.engine,
+                    cancel_cb=self._drain.is_set, **kwargs)
+                return None
             search_by_chunks(
                 lease["fname"], chunks=lease["chunks"],
                 output_dir=lease["output_dir"], resume=True,

@@ -119,6 +119,43 @@ def simulate_pulsar_data(period=0.033, dm=56.77, tsamp=0.0005, nsamples=16384,
     return array, header
 
 
+#: speed of light (m/s) — kept equal to periodicity.accel.C_M_S (the
+#: search-side constant) so injected and searched accelerations agree
+_C_M_S = 299792458.0
+
+
+def simulate_accel_pulsar_data(freq=60.0, dm=150.0, accel=0.0,
+                               tsamp=0.0005, nsamples=16384, nchan=32,
+                               start_freq=1200., bandwidth=200.,
+                               signal=1.0, noise=0.5, duty_cycle=0.05,
+                               floor=20.0, rng=None):
+    """Simulate a dispersed **accelerated** (binary) pulsar.
+
+    Apparent phase ``phi(t) = f0 (t + a t^2 / (2 c))`` — the constant
+    line-of-sight-acceleration Doppler track the acceleration search
+    straightens with trial ``a == accel`` (sign convention pinned by
+    ``tests/test_period_backend.py``).  ``floor`` adds a constant
+    offset so unsigned-integer quantisation in a written filterbank
+    keeps the noise floor.  One generator serves the chaos drill,
+    bench config 17 and the tests — the injection physics must never
+    fork (drifting ground truths between the drill and the perf gate
+    would gate different claims).
+    """
+    rng = np.random.default_rng(rng) \
+        if not isinstance(rng, np.random.Generator) else rng
+    t = np.arange(nsamples) * tsamp
+    phase = freq * (t + accel * t * t / (2.0 * _C_M_S))
+    dist = np.minimum(phase % 1.0, 1.0 - (phase % 1.0))
+    profile = signal * np.exp(-0.5 * (dist / duty_cycle) ** 2)
+    array = np.abs(rng.normal(np.broadcast_to(profile,
+                                              (nchan, nsamples)),
+                              noise)) + floor
+    array = disperse_array(array, dm, start_freq, bandwidth, tsamp)
+    header = _sigpyproc_style_header(nchan, nsamples, tsamp, start_freq,
+                                     bandwidth)
+    return array, header
+
+
 def inject_rfi(array, bad_channels=(), bad_channel_scale=10.0,
                impulse_times=(), impulse_scale=20.0, rng=None):
     """Contaminate a filterbank with narrowband and impulsive broadband RFI.

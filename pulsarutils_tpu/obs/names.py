@@ -180,6 +180,29 @@ METRIC_NAMES = {
         "dispatch-splitting decisions under memory pressure (labelled "
         "by stage: preflight = split planned before compiling, ladder "
         "= split after a caught OOM)",
+    "putpu_period_canary_recall":
+        "periodic-canary recall of the last trial search (1 = the "
+        "injected synthetic pulsar was recovered)",
+    "putpu_period_candidates_total":
+        "raw above-threshold periodicity candidates from the (DM, "
+        "accel) trial search",
+    "putpu_period_chunks_accumulated_total":
+        "chunk planes folded into the full-observation DM-time "
+        "accumulator",
+    "putpu_period_folds_total":
+        "sift-surviving periodicity candidates phase-folded into "
+        "profiles",
+    "putpu_period_jobs_total":
+        "periodicity jobs completed end to end (accumulate -> trial "
+        "search -> sift -> fold -> persist)",
+    "putpu_period_sift_rejected_total":
+        "periodicity-sift rejections (labelled zap/dm_duplicate/"
+        "harmonic)",
+    "putpu_period_snapshot_writes_total":
+        "accumulator resume snapshots persisted beside the chunk "
+        "ledger",
+    "putpu_period_trials_total":
+        "(DM, accel) periodicity trials searched",
     "putpu_persist_dead_letter_total":
         "candidate persists abandoned to the dead-letter manifest",
     "putpu_plan_cache_hits_total":

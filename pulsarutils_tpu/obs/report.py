@@ -45,7 +45,7 @@ def _fmt(v, nd=3):
 
 def build_report(*, meta=None, budget=None, roofline=None, health=None,
                  canary=None, quarantine=None, sift=None, metrics=None,
-                 coincidence=None, fleet=None):
+                 coincidence=None, fleet=None, periodicity=None):
     """Assemble the structured report record (JSON-ready).
 
     ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
@@ -57,7 +57,9 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
     out for the header); ``coincidence``: ``{"stats": COINCIDENCE_JSON
     dict, "groups": beams.coincidence.group_summary(...) rows}`` from
     the multi-beam driver; ``fleet``:
-    ``FleetCoordinator.summary()`` from a coordinator run (ISSUE 9).
+    ``FleetCoordinator.summary()`` from a coordinator run (ISSUE 9);
+    ``periodicity``: the periodicity driver's ``PERIOD_JSON`` summary
+    plus its folded candidate rows (ISSUE 13).
     """
     rec = {
         "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -70,6 +72,7 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
         "sift": sift,
         "coincidence": coincidence,
         "fleet": fleet,
+        "periodicity": periodicity,
     }
     if metrics:
         totals = {}
@@ -306,6 +309,47 @@ def render_markdown(rec):
     else:
         lines.append("Single-process run: no fleet coordinator was "
                      "involved.")
+    lines.append("")
+
+    lines.append("## Periodicity search")
+    lines.append("")
+    period = rec.get("periodicity")
+    if period:
+        lines.append(
+            f"{period.get('n_dm', '?')} DM x {period.get('n_accel', '?')} "
+            f"acceleration trials over a "
+            f"{_fmt(period.get('t_obs_s'), 1)} s accumulated "
+            f"observation (rebin {period.get('rebin', '?')}, "
+            f"{period.get('nout', '?')} samples); "
+            f"{period.get('raw_candidates', 0)} raw candidates, "
+            f"**{period.get('kept', 0)} kept** after the sift "
+            "(rejected: `" + json.dumps(period.get("rejected", {}))
+            + "`).")
+        lines.append("")
+        pc = period.get("canary")
+        if pc:
+            lines.append(
+                ("Periodic canary **recovered**"
+                 if pc.get("recovered") else
+                 "Periodic canary **MISSED**")
+                + f" (injected at DM row {pc.get('dm_index')}, "
+                  f"f={_fmt(pc.get('freq'), 4)} Hz).")
+            lines.append("")
+        cands = period.get("candidates") or period.get("top") or []
+        if cands:
+            lines.append(_md_table(
+                ("f (Hz)", "P (s)", "DM", "accel (m/s^2)", "sigma",
+                 "nharm", "H"),
+                [(_fmt(c.get("freq"), 6),
+                  _fmt(1.0 / c["freq"], 6) if c.get("freq") else "-",
+                  _fmt(c.get("dm"), 2), _fmt(c.get("accel"), 1),
+                  _fmt(c.get("sigma"), 1), c.get("nharm", "-"),
+                  _fmt(c.get("h"), 1)) for c in cands]))
+        else:
+            lines.append("No candidates above the significance floor.")
+    else:
+        lines.append("No periodicity search ran (single-pulse "
+                     "workload).")
     lines.append("")
 
     lines.append("## Memory pressure")

@@ -61,6 +61,26 @@ def quick_resample(counts, factor, xp=np):
     return out[0] if squeeze else out
 
 
+def stretch_resample(x, indices, xp=np):
+    """Resample along the time (last) axis at precomputed sample indices.
+
+    The **fractional-stretch generalisation** of :func:`quick_resample`
+    (the reference's resampling primitive only ever rebinned by an
+    integer factor): ``out[..., n] = x[..., indices[n]]`` for any
+    monotone index map, so a caller can stretch the time axis by a
+    *non-integer, even time-varying* rate — the acceleration-search
+    resample (:mod:`~pulsarutils_tpu.periodicity.accel`) maps
+    ``n -> n - kappa n^2``.  ``indices`` must be integer, precomputed
+    on the host in float64 (index arithmetic in float32 drifts by
+    whole samples past ``n ~ 2^24``) and already clipped to the axis.
+
+    >>> stretch_resample(np.arange(6.0), np.array([0, 2, 4]))
+    array([0., 2., 4.])
+    """
+    x = xp.asarray(x)
+    return xp.take(x, indices, axis=-1)
+
+
 def block_sum_time(x, factor, xp=np):
     """Block-sum a batch of series ``(..., T)`` along the last axis.
 

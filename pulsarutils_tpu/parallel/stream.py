@@ -103,7 +103,8 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   dm_block=None, chan_block=None, budget=None, mesh=None,
                   kernel="auto", dispatch_timeout=None, dispatch_retries=0,
                   skip_failed=False, health=None, http_port=None,
-                  http_host="127.0.0.1", canary=None):
+                  http_host="127.0.0.1", canary=None,
+                  plane_consumer=None):
     """Search an iterable of ``(istart, (nchan, step))`` chunks.
 
     One compiled executable serves every distinct chunk shape; interior
@@ -172,6 +173,13 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     are quantized into the packed codes on the same seam
     (:meth:`~pulsarutils_tpu.obs.canary.CanaryController.
     maybe_inject_packed`), so recall is measured on packed runs too.
+
+    ``plane_consumer`` (ISSUE 13, same contract as
+    ``search_by_chunks``): a ``fn(istart, plane, table)`` callable
+    that forces plane capture on every chunk's search and receives the
+    dedispersed plane (device array, or a sharded handle on the mesh
+    route) before it is dropped — the periodicity accumulation seam.
+    ``None`` (default) keeps the pre-seam code path byte-identical.
     """
     import contextlib
     import time as _time
@@ -203,6 +211,12 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     if budget is not None:
         budget.begin_stream()
 
+    # the plane-consumer seam forces capture; the kwarg is only passed
+    # when armed so the seam-off dispatch signature (and its compiled
+    # programs) stays byte-identical to the pre-seam driver
+    capture_kw = {"capture_plane": True} if plane_consumer is not None \
+        else {}
+
     def run_one(istart, chunk):
         fault_inject.fire("dispatch", chunk=istart, backend=backend)
         if mesh is not None and backend == "jax":
@@ -211,22 +225,27 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
 
                 return sharded_hybrid_search(
                     chunk, dmmin, dmmax, start_freq, bandwidth,
-                    sample_time, mesh=mesh)
+                    sample_time, mesh=mesh, **capture_kw)
             if kernel == "fdmt":
                 from .sharded_fdmt import sharded_fdmt_search
 
                 return sharded_fdmt_search(
                     chunk, dmmin, dmmax, start_freq, bandwidth,
-                    sample_time, mesh=mesh)
+                    sample_time, mesh=mesh, **capture_kw)
             from .sharded import sharded_dedispersion_search
 
             return sharded_dedispersion_search(
                 chunk, dmmin, dmmax, start_freq, bandwidth, sample_time,
-                mesh=mesh, trial_dms=trial_dms, chan_block=chan_block)
+                mesh=mesh, trial_dms=trial_dms, chan_block=chan_block,
+                # the documented consumer contract: a DM-sharded
+                # device-resident handle, never an eagerly-gathered
+                # host plane (search_by_chunks' mesh seam rule)
+                **(dict(capture_kw, plane_handle=True) if capture_kw
+                   else {}))
         return dedispersion_search(
             chunk, dmmin, dmmax, start_freq, bandwidth, sample_time,
             backend=backend, trial_dms=trial_dms, dm_block=dm_block,
-            chan_block=chan_block,
+            chan_block=chan_block, **capture_kw,
             **({} if kernel == "auto" else {"kernel": kernel}))
 
     def run_guarded(istart, chunk):
@@ -362,7 +381,12 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
             try:
                 with (budget.bucket("search") if budget is not None
                       else span("search")):
-                    table = run_guarded(istart, chunk)
+                    result = run_guarded(istart, chunk)
+                if plane_consumer is not None:
+                    table, _plane = result
+                    plane_consumer(istart, _plane, table)
+                else:
+                    table = result
             except (ValueError, TypeError):
                 raise
             except Exception:

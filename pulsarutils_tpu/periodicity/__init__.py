@@ -1,0 +1,30 @@
+"""Survey-scale periodicity backend (ISSUE 13).
+
+Periodicity sensitivity grows as sqrt(T_obs), so the per-chunk rescue
+seam (``period_search_plane`` on one chunk's plane) throws away almost
+all of it.  This package is the full-observation workload:
+
+* :mod:`.accumulate` — stream chunk planes out of the existing
+  dedispersion surfaces (the ``plane_consumer`` seam of
+  ``search_by_chunks`` / ``stream_search``) into one rebinned
+  DM–time plane covering the whole observation, sized by the memory
+  budget;
+* :mod:`.accel` — acceleration (binary-pulsar) trials by time-domain
+  fractional resampling, searched with the existing
+  rfft -> ``normalize_power`` -> ``harmonic_sum`` stack as one batched
+  program over the (DM, accel) trial axes (host / jit / sharded-mesh
+  paths pinned identical);
+* :mod:`.candidates` — the harmonic-aware candidate pipeline: zap
+  (birdie) list, integer-harmonic sift, DM-adjacency grouping, batched
+  phase-folding of survivors;
+* :mod:`.driver` — the end-to-end job: accumulate -> trial search ->
+  sift -> fold -> persist, with snapshot-based exact resume riding the
+  chunk ledger, a periodic canary, and the service/fleet seams.
+"""
+
+from .accumulate import DMTimeAccumulator, choose_rebin  # noqa: F401
+from .accel import (accel_grid, accel_search,  # noqa: F401
+                    fractional_resample)
+from .candidates import (ZapList, fold_candidates,  # noqa: F401
+                         sift_candidates)
+from .driver import periodicity_search  # noqa: F401

@@ -1,0 +1,271 @@
+"""Acceleration search: time-domain resampling trials over the
+accumulated DM–time plane.
+
+A pulsar in a binary accelerates along the line of sight, so its
+apparent spin frequency drifts across a long observation and the power
+that a fixed-frequency FFT bin would collect smears over ``z = f a
+T_obs^2 / c`` Fourier bins.  The classic remedy (PulsarX, PRESTO) is
+**time-domain resampling**: for each trial acceleration ``a``, remap
+sample ``n`` to ``n - a t(n)^2 / (2 c t_samp)`` — the fractional-stretch
+generalisation of the reference's ``quick_resample`` primitive
+(:func:`~pulsarutils_tpu.ops.rebin.stretch_resample`) — which walks the
+drift back out; the already-proven rfft ->
+:func:`~pulsarutils_tpu.ops.periodicity.normalize_power` ->
+:func:`~pulsarutils_tpu.ops.periodicity.harmonic_sum` stack then scores
+the straightened series.
+
+Execution contract (the repo-wide kernel rule):
+
+* **host path** (``xp=numpy``) — the reference semantics, one python
+  loop over trials;
+* **jit path** — ONE compiled program per geometry
+  (:func:`~pulsarutils_tpu.tuning.geometry.counted_plan_cache`):
+  ``lax.map`` over the accel axis (one trial's resample + FFT workspace
+  live at a time), device-side top-k over the flattened (accel, DM)
+  sigma grid;
+* **mesh path** — the same per-trial body ``shard_map``-ped over the
+  existing ``(dm, chan)`` mesh with DM trials on the ``dm`` axis and
+  accel trials on the ``chan`` axis; only the tiny per-trial score
+  vectors are gathered.
+
+All three paths share one scoring implementation
+(:func:`~pulsarutils_tpu.ops.periodicity.spectral_search`) and one
+top-k selection rule (stable descending sigma, ties to the lower
+``(accel, dm)`` flat index), so the candidate tables agree cell-for-
+cell: discrete fields exactly, scores to float tolerance (the host
+path runs numpy float64 where the device runs float32 — the
+autotuner's own equivalence contract).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.periodicity import _SPEC_KEYS, spectral_search
+from ..ops.rebin import stretch_resample
+from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
+
+__all__ = ["C_M_S", "accel_grid", "accel_search", "fractional_resample",
+           "stretch_index_table"]
+
+#: speed of light (m/s) — acceleration trials are in m/s^2
+C_M_S = 299792458.0
+
+
+def stretch_index_table(accels, nsamples, tsamp):
+    """Per-trial gather indices for the quadratic time stretch.
+
+    Sample ``n`` of the resampled series reads input sample
+    ``round(n - kappa n^2)`` with ``kappa = a t_samp / (2 c)`` — the
+    first-order Doppler path-length correction for constant line-of-
+    sight acceleration ``a``: a series generated with apparent phase
+    ``phi(t) = f0 (t + a t^2 / (2 c))`` is straightened back to a
+    constant ``f0`` by the SAME ``a`` (sign convention pinned by
+    ``tests/test_period_backend.py``).  Indices are computed in host
+    float64 (the anchored-fold rule: float32 index arithmetic drifts
+    by whole samples past ``n ~ 2^24``) and clipped to the series.
+    Returns ``(n_accel, nsamples)`` int32.
+    """
+    n = np.arange(int(nsamples), dtype=np.float64)
+    kappa = (np.atleast_1d(np.asarray(accels, dtype=np.float64))[:, None]
+             * float(tsamp) / (2.0 * C_M_S))
+    idx = np.rint(n[None, :] - kappa * n[None, :] ** 2)
+    return np.clip(idx, 0, int(nsamples) - 1).astype(np.int32)
+
+
+def fractional_resample(series, accel, tsamp, xp=np):
+    """Resample ``series`` (..., T) for one trial acceleration.
+
+    The fractional-stretch generalisation of ``quick_resample``: where
+    the integer rebin sums fixed blocks, this gathers each output
+    sample from a quadratically-drifting input position
+    (:func:`stretch_index_table`).  ``accel=0`` is the identity.
+    """
+    idx = stretch_index_table(accel, np.shape(series)[-1], tsamp)[0]
+    return stretch_resample(series, idx if xp is np else xp.asarray(idx),
+                            xp=xp)
+
+
+def accel_grid(accel_max, tsamp, nsamples, f_ref=None, max_trials=1025):
+    """Symmetric trial accelerations ``[-accel_max, accel_max]``.
+
+    Spacing ``da = 2 c / (f_ref T_obs^2)`` keeps the residual drift of
+    a signal at ``f_ref`` under ~one Fourier bin between adjacent
+    trials; ``f_ref`` defaults to the Nyquist frequency (conservative —
+    every lower frequency is oversampled).  Always includes 0 exactly;
+    ``max_trials`` bounds the grid (spacing widens past it, logged by
+    the driver).  ``accel_max <= 0`` returns the single zero trial.
+    """
+    if accel_max <= 0:
+        return np.zeros(1)
+    t_obs = float(nsamples) * float(tsamp)
+    if f_ref is None:
+        f_ref = 0.5 / float(tsamp)
+    da = 2.0 * C_M_S / (float(f_ref) * t_obs * t_obs)
+    n_side = max(int(np.ceil(float(accel_max) / da)), 1)
+    n_side = min(n_side, (int(max_trials) - 1) // 2)
+    return (np.arange(-n_side, n_side + 1, dtype=np.float64)
+            * (float(accel_max) / n_side))
+
+
+def _select_topk(sigma, k):
+    """Top-``k`` flat indices of ``sigma`` (n_accel, ndm), stable
+    descending — ties resolve to the lower (accel, dm) flat index,
+    matching ``lax.top_k``'s rule so every path selects identically."""
+    flat = np.asarray(sigma, dtype=np.float64).reshape(-1)
+    order = np.argsort(-flat, kind="stable")
+    return order[: min(int(k), flat.size)]
+
+
+def _result_table(stacked, flat_idx, accels, tsamp, nsamples):
+    """Assemble the candidate table from a ``(n_accel, 5, ndm)`` score
+    stack and selected flat indices."""
+    naccel, _, ndm = stacked.shape
+    flat_idx = np.asarray(flat_idx, dtype=np.int64)
+    a_idx = flat_idx // ndm
+    d_idx = flat_idx % ndm
+    fields = {key: np.asarray(stacked[a_idx, i, d_idx])
+              for i, key in enumerate(_SPEC_KEYS)}
+    return {
+        "dm_index": d_idx.astype(np.int64),
+        "accel_index": a_idx.astype(np.int64),
+        "accel": np.asarray(accels, dtype=np.float64)[a_idx],
+        "freq": fields["freq"].astype(np.float64),
+        "freq_bin": np.rint(fields["freq"].astype(np.float64)
+                            * nsamples * tsamp).astype(np.int64),
+        "power": fields["power"].astype(np.float64),
+        "nharm": np.rint(fields["nharm"]).astype(np.int32),
+        "log_sf": fields["log_sf"].astype(np.float64),
+        "sigma": fields["sigma"].astype(np.float64),
+    }
+
+
+@counted_plan_cache("period_accel", maxsize=PLAN_CACHE_SIZE)
+def _accel_program(tsamp, ndm, nsamples, naccel, max_harmonics, fmin, fmax,
+                   topk):
+    """ONE jitted program for the whole (DM, accel) trial sweep:
+    ``lax.map`` over accel trials (a single trial's resampled plane +
+    spectrum workspace is live at a time) of the shared spectral
+    scorer, then device-side top-k over the flattened sigma grid."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(plane, idx_table):
+        def one(idx):
+            res = spectral_search(
+                jnp.take(plane, idx, axis=-1), tsamp,
+                max_harmonics=max_harmonics, fmin=fmin, fmax=fmax, xp=jnp)
+            return jnp.stack([res[k].astype(jnp.float32)
+                              for k in _SPEC_KEYS])
+        stacked = jax.lax.map(one, idx_table)          # (naccel, 5, ndm)
+        sigma = stacked[:, _SPEC_KEYS.index("sigma"), :].reshape(-1)
+        k = min(int(topk), naccel * ndm)
+        _vals, flat_idx = jax.lax.top_k(sigma, k)
+        return stacked, flat_idx
+
+    return run
+
+
+@counted_plan_cache("period_accel_mesh", maxsize=PLAN_CACHE_SIZE)
+def _accel_program_sharded(mesh, tsamp, ndm_pad, nsamples, naccel_pad,
+                           max_harmonics, fmin, fmax):
+    """The trial sweep sharded over the existing mesh: DM trials on the
+    ``dm`` axis, accel trials on the ``chan`` axis; each device scores
+    its (DM block x accel block) with the identical per-trial body and
+    only the per-trial score vectors leave the devices."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    def local(plane_local, idx_local):
+        def one(idx):
+            res = spectral_search(
+                jnp.take(plane_local, idx, axis=-1), tsamp,
+                max_harmonics=max_harmonics, fmin=fmin, fmax=fmax, xp=jnp)
+            return jnp.stack([res[k].astype(jnp.float32)
+                              for k in _SPEC_KEYS])
+        return jax.lax.map(one, idx_local)     # (naccel_loc, 5, ndm_loc)
+
+    fn = shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(P("dm", None), P("chan", None)),
+        out_specs=P("chan", None, "dm"))
+
+    @jax.jit
+    def run(plane, idx_table):
+        return fn(plane, idx_table)            # (naccel_pad, 5, ndm_pad)
+
+    return run
+
+
+def accel_search(plane, tsamp, accels, *, max_harmonics=16, fmin=None,
+                 fmax=None, topk=32, xp=np, mesh=None):
+    """Search the accumulated plane over the (DM, accel) trial grid.
+
+    ``plane`` is the ``(ndm, T)`` full-observation DM–time plane
+    (:class:`~pulsarutils_tpu.periodicity.accumulate.DMTimeAccumulator`
+    ``.plane``); ``accels`` the trial accelerations (m/s^2, include 0).
+    Returns the top-``topk`` candidate table as a dict of aligned
+    arrays: ``dm_index, accel_index, accel, freq, freq_bin, power,
+    nharm, log_sf, sigma`` — sorted by descending sigma with the
+    deterministic tie rule shared by all paths.
+
+    ``xp=numpy`` runs the host reference; ``xp=jax.numpy`` runs the
+    single batched jitted program; ``mesh`` additionally shards the
+    trial axes over ``(dm, chan)``.
+    """
+    plane = np.asarray(plane, dtype=np.float32) if xp is np else plane
+    ndm, nsamples = np.shape(plane)
+    accels = np.atleast_1d(np.asarray(accels, dtype=np.float64))
+    idx_table = stretch_index_table(accels, nsamples, tsamp)
+    naccel = len(accels)
+    lo = None if fmin is None else float(fmin)
+    hi = None if fmax is None else float(fmax)
+
+    if xp is np:
+        stacked = np.zeros((naccel, 5, ndm), dtype=np.float64)
+        for a in range(naccel):
+            res = spectral_search(
+                np.take(plane, idx_table[a], axis=-1), tsamp,
+                max_harmonics=max_harmonics, fmin=lo, fmax=hi, xp=np)
+            stacked[a] = np.stack([np.asarray(res[k], dtype=np.float64)
+                                   for k in _SPEC_KEYS])
+        flat_idx = _select_topk(stacked[:, _SPEC_KEYS.index("sigma"), :],
+                                topk)
+        return _result_table(stacked, flat_idx, accels, tsamp, nsamples)
+
+    import jax.numpy as jnp
+
+    if mesh is not None:
+        n_dm_shards = mesh.shape["dm"]
+        n_acc_shards = mesh.shape["chan"]
+        ndm_pad = -(-ndm // n_dm_shards) * n_dm_shards
+        nacc_pad = -(-naccel // n_acc_shards) * n_acc_shards
+        plane_dev = jnp.asarray(plane, dtype=jnp.float32)
+        if ndm_pad != ndm:
+            plane_dev = jnp.pad(plane_dev, ((0, ndm_pad - ndm), (0, 0)))
+        idx_pad = idx_table
+        if nacc_pad != naccel:
+            # pad with the zero-accel identity mapping; rows discarded
+            ident = stretch_index_table([0.0], nsamples, tsamp)
+            idx_pad = np.concatenate(
+                [idx_table, np.repeat(ident, nacc_pad - naccel, axis=0)])
+        run = _accel_program_sharded(mesh, float(tsamp), ndm_pad,
+                                     int(nsamples), nacc_pad,
+                                     int(max_harmonics), lo, hi)
+        stacked = np.asarray(run(plane_dev, jnp.asarray(idx_pad)),
+                             dtype=np.float64)[:naccel, :, :ndm]
+        flat_idx = _select_topk(stacked[:, _SPEC_KEYS.index("sigma"), :],
+                                topk)
+        return _result_table(stacked, flat_idx, accels, tsamp, nsamples)
+
+    run = _accel_program(float(tsamp), int(ndm), int(nsamples),
+                         int(naccel), int(max_harmonics), lo, hi,
+                         int(topk))
+    stacked, flat_idx = run(jnp.asarray(plane, dtype=jnp.float32),
+                            jnp.asarray(idx_table))
+    return _result_table(np.asarray(stacked, dtype=np.float64),
+                         np.asarray(flat_idx), accels, tsamp, nsamples)

@@ -1,0 +1,281 @@
+"""Full-observation DM–time accumulation from streamed chunk planes.
+
+The streaming drivers search 50%-overlapped chunks whose dedispersed
+planes are dropped once scored; periodicity sensitivity grows as
+sqrt(T_obs), so this module keeps them: each chunk's plane is folded
+into ONE host-resident ``(ndm, T_obs / rebin)`` plane covering the
+whole observation.
+
+Geometry rules (all derived from the driver's own
+:class:`~pulsarutils_tpu.parallel.stream.ChunkPlan`):
+
+* every chunk contributes its **first ``hop`` samples** — the chunks
+  overlap 50%, so first-hop slices tile the observation exactly once,
+  and because the per-chunk dedispersion is circular with delay span
+  <= ``hop``, the first-hop region is the wrap-free half of every
+  chunk.  The final chunk contributes its full extent (the tail would
+  otherwise be lost); its back half can carry bounded circular-wrap
+  artifacts, stated in ``docs/periodicity.md``;
+* the time axis is **rebinned** by a power of two dividing the
+  effective hop, chosen by :func:`choose_rebin` so the plane fits
+  ``SAFETY_FRACTION`` of the budget
+  (:mod:`~pulsarutils_tpu.resilience.memory_budget`) — the host plane
+  IS the spill floor, so an unknown budget falls back to a fixed host
+  cap rather than refusing to run;
+* chunk contributions land in **disjoint column ranges**, so
+  accumulation order cannot change a single byte and a chunk consumed
+  twice (crash between consume and ledger mark) is de-duplicated by
+  its start index — the property the resume snapshot and the chaos
+  drill's byte-identity class rely on.
+
+Snapshots (:meth:`DMTimeAccumulator.save` / :meth:`.load`) persist the
+partial plane beside the chunk ledger with the same atomic
+tmp+``os.replace`` rule as every other durable artifact, so a killed
+periodicity job resumes accumulation exactly where the ledger says it
+stopped.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..utils.logging_utils import logger
+
+__all__ = ["DEFAULT_HOST_PLANE_BYTES", "DMTimeAccumulator", "choose_rebin"]
+
+#: plane-size cap when no device/operator budget is known (the host
+#: plane is the spill floor; 256 MB holds ~4096 trials x 16M samples
+#: at rebin 1024 and is modest beside a survey chunk's own footprint)
+DEFAULT_HOST_PLANE_BYTES = 1 << 28
+
+
+def choose_rebin(ndm, nsamples_eff, hop_eff, budget_bytes=None):
+    """The smallest power-of-two rebin factor (dividing ``hop_eff``)
+    whose ``(ndm, nsamples_eff / rebin)`` float32 plane fits
+    ``SAFETY_FRACTION`` of the budget.
+
+    ``budget_bytes=None`` consults the device budget
+    (:func:`~pulsarutils_tpu.resilience.memory_budget.
+    device_budget_bytes`) and falls back to
+    :data:`DEFAULT_HOST_PLANE_BYTES` when none is known.  When even the
+    largest admissible factor does not fit, that factor is returned
+    anyway with a warning — the host plane is the floor, and a coarse
+    plane beats no periodicity search at all.
+    """
+    from ..resilience.memory_budget import SAFETY_FRACTION, device_budget_bytes
+
+    if budget_bytes is None:
+        budget_bytes = device_budget_bytes()
+    if budget_bytes is None:
+        budget_bytes = DEFAULT_HOST_PLANE_BYTES
+    usable = SAFETY_FRACTION * float(budget_bytes)
+    hop_eff = max(int(hop_eff), 1)
+    rebin = 1
+    while (int(ndm) * (int(nsamples_eff) // rebin + 1) * 4 > usable
+           and rebin * 2 <= hop_eff and hop_eff % (rebin * 2) == 0):
+        rebin *= 2
+    if int(ndm) * (int(nsamples_eff) // rebin + 1) * 4 > usable:
+        logger.warning(
+            "periodicity plane (%d x %d at rebin %d) exceeds the %.0f MB "
+            "budget even at the coarsest hop-aligned rebin; proceeding "
+            "on the host-spill floor", ndm, int(nsamples_eff) // rebin,
+            rebin, usable / 1e6)
+    return rebin
+
+
+_SNAP_VERSION = 1
+
+
+class DMTimeAccumulator:
+    """Accumulate streamed chunk planes into one observation plane.
+
+    ``plan`` is the survey's :class:`~pulsarutils_tpu.parallel.stream.
+    ChunkPlan`; ``nsamples`` the file's raw sample count;
+    ``chunk_starts`` the planned chunk grid (the last start is the one
+    whose full extent is kept).  ``rebin="auto"`` sizes the plane by
+    the memory budget (:func:`choose_rebin`); an explicit integer must
+    be a power of two dividing the effective hop.
+    """
+
+    def __init__(self, plan, nsamples, chunk_starts, ndm, *, rebin="auto",
+                 budget_bytes=None, trial_dms=None):
+        if plan.hop % plan.resample:
+            raise ValueError(
+                f"hop {plan.hop} not divisible by resample {plan.resample}"
+                " — the chunk grid cannot tile the effective time axis")
+        self.plan = plan
+        self.nsamples = int(nsamples)
+        self.chunk_starts = [int(s) for s in chunk_starts]
+        self.ndm = int(ndm)
+        self.hop_eff = plan.hop // plan.resample
+        self.tsamp_chunk = float(plan.sample_time)
+        last = max(self.chunk_starts) if self.chunk_starts else 0
+        # effective length of the tiled observation: first-hop slices up
+        # to the last chunk, then the last chunk's full (possibly
+        # ragged) extent
+        self.nsamples_eff = (last // plan.resample
+                             + min(plan.step, self.nsamples - last)
+                             // plan.resample)
+        if rebin == "auto":
+            rebin = choose_rebin(self.ndm, self.nsamples_eff, self.hop_eff,
+                                 budget_bytes=budget_bytes)
+        rebin = int(rebin)
+        if rebin < 1 or self.hop_eff % rebin:
+            raise ValueError(f"rebin {rebin} must divide the effective "
+                             f"hop {self.hop_eff}")
+        self.rebin = rebin
+        self.tsamp = self.tsamp_chunk * rebin
+        self.nout = self.nsamples_eff // rebin
+        self.plane = np.zeros((self.ndm, self.nout), dtype=np.float32)
+        self.trial_dms = (None if trial_dms is None
+                          else np.asarray(trial_dms, dtype=np.float64))
+        self.seen = set()
+
+    # -- consumption (the plane_consumer seam calls this) -------------------
+
+    @property
+    def complete(self):
+        """True once every planned chunk has been folded in."""
+        return self.seen >= set(self.chunk_starts)
+
+    @property
+    def coverage(self):
+        """Fraction of planned chunks folded in so far."""
+        if not self.chunk_starts:
+            return 1.0
+        return len(self.seen & set(self.chunk_starts)) \
+            / len(self.chunk_starts)
+
+    def consume(self, istart, plane, table=None):
+        """Fold one chunk's dedispersed plane into the observation plane.
+
+        ``plane`` may be a host array, a device array, or a DM-sharded
+        :class:`~pulsarutils_tpu.parallel.sharded_plane.ShardedPlane`
+        handle (materialised whole — the accumulator needs every row's
+        hop prefix, so row-wise fetches would cost ndm round trips).
+        A chunk start already consumed is ignored (idempotent: the
+        crash window between consume and the ledger's ``mark_done``
+        re-delivers a chunk on resume).  ``table`` (the chunk's trial
+        table) pins the DM grid on first consumption and is checked on
+        every later one.
+        """
+        istart = int(istart)
+        if istart in self.seen:
+            return False
+        if istart % self.plan.resample:
+            raise ValueError(f"chunk start {istart} not aligned to the "
+                             f"resample factor {self.plan.resample}")
+        if table is not None and "DM" in getattr(table, "colnames", ()):
+            dms = np.asarray(table["DM"], dtype=np.float64)
+            if self.trial_dms is None:
+                self.trial_dms = dms
+            elif dms.shape != self.trial_dms.shape \
+                    or not np.array_equal(dms, self.trial_dms):
+                raise ValueError(
+                    "chunk trial-DM grid drifted mid-observation — all "
+                    "accumulated chunks must share one grid")
+        if hasattr(plane, "to_host"):      # ShardedPlane handle
+            plane = plane.to_host()
+        plane = np.asarray(plane, dtype=np.float32)
+        if plane.shape[0] != self.ndm:
+            raise ValueError(f"chunk plane has {plane.shape[0]} DM rows, "
+                             f"accumulator expects {self.ndm}")
+        eff_start = istart // self.plan.resample
+        is_last = istart == max(self.chunk_starts)
+        length = plane.shape[1] if is_last else min(self.hop_eff,
+                                                    plane.shape[1])
+        out_lo = eff_start // self.rebin
+        nbins = length // self.rebin   # trailing partial bin dropped
+        if nbins > 0:
+            nbins = min(nbins, self.nout - out_lo)
+            seg = plane[:, : nbins * self.rebin]
+            self.plane[:, out_lo:out_lo + nbins] += seg.reshape(
+                self.ndm, nbins, self.rebin).sum(axis=2)
+        self.seen.add(istart)
+        _metrics.counter("putpu_period_chunks_accumulated_total").inc()
+        return True
+
+    def series(self, dm_index):
+        """One DM trial's accumulated full-observation series."""
+        return self.plane[int(dm_index)]
+
+    # -- snapshots: exact resume beside the chunk ledger ---------------------
+
+    def save(self, path):
+        """Atomically persist the partial plane + consumed-chunk set.
+
+        Written after each consumed chunk (the driver's
+        ``snapshot_every`` knob), BEFORE the chunk's ledger mark lands:
+        a crash between the two re-delivers the chunk on resume and
+        :meth:`consume` de-duplicates it — so snapshot and ledger can
+        never disagree in the direction that loses data.
+        """
+        tmp = str(path) + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, version=np.int64(_SNAP_VERSION),
+                     plane=self.plane,
+                     seen=np.asarray(sorted(self.seen), dtype=np.int64),
+                     rebin=np.int64(self.rebin),
+                     nsamples=np.int64(self.nsamples),
+                     hop=np.int64(self.plan.hop),
+                     step=np.int64(self.plan.step),
+                     resample=np.int64(self.plan.resample),
+                     trial_dms=(np.zeros(0) if self.trial_dms is None
+                                else self.trial_dms))
+        os.replace(tmp, path)
+        _metrics.counter("putpu_period_snapshot_writes_total").inc()
+
+    def restore(self, path):
+        """Load a snapshot written by :meth:`save`; returns True when
+        state was restored.  A missing/torn/mismatched snapshot is NOT
+        an error — accumulation restarts from zero (the ledger-backed
+        chunk search is idempotent), with the torn file backed up
+        ``.corrupt`` per the ledger durability rule."""
+        try:
+            with np.load(path, allow_pickle=False) as snap:
+                if int(snap["version"]) != _SNAP_VERSION:
+                    logger.warning(
+                        "periodicity snapshot %s has schema version %d "
+                        "(this build writes %d); ignoring it", path,
+                        int(snap["version"]), _SNAP_VERSION)
+                    return False
+                if (int(snap["rebin"]) != self.rebin
+                        or int(snap["nsamples"]) != self.nsamples
+                        or int(snap["hop"]) != self.plan.hop
+                        or int(snap["step"]) != self.plan.step
+                        or int(snap["resample"]) != self.plan.resample
+                        or snap["plane"].shape != self.plane.shape):
+                    logger.warning(
+                        "periodicity snapshot %s was written for a "
+                        "different geometry; ignoring it", path)
+                    return False
+                self.plane = np.array(snap["plane"], dtype=np.float32)
+                self.seen = {int(s) for s in snap["seen"]}
+                dms = snap["trial_dms"]
+                if dms.size:
+                    self.trial_dms = np.array(dms, dtype=np.float64)
+        except FileNotFoundError:
+            return False
+        except (OSError, ValueError, KeyError, zipfile_err()) as exc:
+            logger.warning("periodicity snapshot %s unreadable (%r); "
+                           "restarting accumulation", path, exc)
+            try:
+                os.replace(path, str(path) + ".corrupt")
+            except OSError:
+                pass
+            return False
+        logger.info("periodicity accumulation resumed: %d/%d chunks "
+                    "already folded in", len(self.seen),
+                    len(self.chunk_starts))
+        return True
+
+
+def zipfile_err():
+    """The npz container's torn-file exception class (import kept out
+    of the hot path)."""
+    import zipfile
+
+    return zipfile.BadZipFile

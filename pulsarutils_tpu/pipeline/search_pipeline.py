@@ -243,9 +243,18 @@ def plan_survey(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 kernel="auto", snr_threshold=6.0, fft_zap=False,
                 cut_outliers=False, zero_dm=False, mesh=None,
                 exact_floor="auto", quarantine_policy="sanitize",
-                period_search=False, period_sigma_threshold=8.0):
+                period_search=False, period_sigma_threshold=8.0,
+                fingerprint_extra=None):
     """Resolve a survey's geometry, threshold and resume fingerprint
     WITHOUT searching anything.
+
+    ``fingerprint_extra`` (a flat JSON-safe dict) is folded into the
+    resume-ledger fingerprint when non-empty — the workload seam
+    (ISSUE 13): a periodicity job over a file must not share a ledger
+    with a single-pulse survey of the same physics (its accumulation
+    snapshot advances in lockstep with *its* ledger), and ``None``
+    keeps every pre-existing fingerprint byte-identical.  Keys must
+    not collide with the driver's own fingerprint fields.
 
     This is the single source of truth :func:`search_by_chunks` plans
     from, split out (ISSUE 9) so the fleet coordinator
@@ -370,7 +379,12 @@ def plan_survey(fname, chunk_length=None, new_sample_time=None, tmin=0,
            if quarantine_policy != "sanitize" else {}),
         surelybad=sorted(int(c) for c in surelybad),
         period_search=bool(period_search),
-        period_sigma_threshold=float(period_sigma_threshold))
+        period_sigma_threshold=float(period_sigma_threshold),
+        # workload-distinct ledgers (ISSUE 13): merged LAST so a
+        # collision with a driver field fails loudly in review, and
+        # absent entirely when unset — every pre-existing ledger
+        # fingerprint is unchanged
+        **(fingerprint_extra or {}))
 
     return {
         "reader": reader, "plan": plan, "root": root,
@@ -396,7 +410,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      persist_retries=2, persist_backoff=0.05,
                      http_port=None, http_host="127.0.0.1", canary=None,
                      health=None, report_out=None, chunks=None,
-                     cancel_cb=None):
+                     cancel_cb=None, plane_consumer=None,
+                     fingerprint_extra=None):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -574,6 +589,26 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
       session picks up exactly there.  This is the worker's graceful
       drain seam.
 
+    Periodicity seams (ISSUE 13; ``docs/periodicity.md``) — both
+    byte-inert when unset:
+
+    * ``plane_consumer`` (a ``fn(istart, plane, table)`` callable)
+      forces plane capture and hands each searched chunk's dedispersed
+      plane — a device array, or a DM-sharded
+      :class:`~pulsarutils_tpu.parallel.sharded_plane.ShardedPlane`
+      handle on the mesh route — downstream before it is dropped.
+      Called BEFORE the chunk's ledger mark, so a crash window at
+      worst re-delivers a chunk on resume; consumers must de-duplicate
+      by ``istart`` (the
+      :class:`~pulsarutils_tpu.periodicity.accumulate.
+      DMTimeAccumulator` does).  With the single-pulse ``canary``
+      armed, injected chunks' planes carry the synthetic track — the
+      periodicity driver runs canary-off on this leg and injects its
+      own periodic canary downstream;
+    * ``fingerprint_extra`` rides to :func:`plan_survey` so a
+      different *workload* over the same file keeps its own resume
+      ledger.
+
     Returns ``(hits, store)`` where hits is a list of
     ``(istart, iend, PulseInfo, ResultTable)``.  NOTE (round 6): when
     plotting is off, a hit's retained/persisted ``info.allprofs`` is the
@@ -654,7 +689,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      mesh=mesh, exact_floor=exact_floor,
                      quarantine_policy=quarantine_policy,
                      period_search=period_search,
-                     period_sigma_threshold=period_sigma_threshold)
+                     period_sigma_threshold=period_sigma_threshold,
+                     fingerprint_extra=fingerprint_extra)
     reader = sp["reader"]
     root = sp["root"]
     header = reader.header
@@ -681,7 +717,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     hits = []
     nproc = 0
     ncertified = 0
-    capture = bool(make_plots) or bool(period_search)
+    capture = bool(make_plots) or bool(period_search) \
+        or plane_consumer is not None
     fallback_state = {}
 
     # one conditioning pipeline, parameterised by array namespace — the
@@ -1228,6 +1265,15 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                quarantined=True, oom_floor=True)
                 continue
             table, plane = result if capture else (result, None)
+            if plane_consumer is not None and plane is not None:
+                # the periodicity accumulation seam: the consumer sees
+                # the plane (device array or ShardedPlane handle)
+                # before the sift/persist machinery drops it, and
+                # before mark_done — so the consumer's own durable
+                # state can never be AHEAD of the ledger in the
+                # direction that loses data
+                with with_timer("plane_consume"):
+                    plane_consumer(istart, plane, table)
             if reader.ibeam is not None:
                 # chunk metadata rides the in-process table (meta is not
                 # persisted; the PulseInfo fields are the durable copy)

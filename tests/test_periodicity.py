@@ -211,6 +211,70 @@ class TestSearch:
         assert abs(float(rj["freq"]) - float(rn["freq"])) < 1e-3
 
 
+class TestSeamEdgeCases:
+    """Previously untested corners of the periodicity seam (ISSUE 13
+    satellites): off-grid frequency recovery through ``refine_grid``
+    and ``epoch_folding_search`` short-series degeneracies."""
+
+    def test_refine_grid_shape_and_span(self):
+        grid = refine_grid(10.0, 0.001, 4096, oversample=8,
+                           half_width_bins=2)
+        df = 1.0 / (4096 * 0.001)
+        assert grid.size == 2 * 2 * 8 + 1
+        assert grid[grid.size // 2] == pytest.approx(10.0)
+        assert grid[0] == pytest.approx(10.0 - 2 * df)
+        assert grid[-1] == pytest.approx(10.0 + 2 * df)
+        np.testing.assert_allclose(np.diff(grid), df / 8)
+
+    def test_refine_grid_recovers_off_grid_frequency(self):
+        # a tone 0.37 Fourier bins off the grid: the spectral stage can
+        # only name the nearest bin, the refine grid + epoch folding
+        # must localise the true frequency to sub-bin precision
+        tsamp, t = 0.001, 1 << 14
+        df = 1.0 / (t * tsamp)
+        f_true = (180 + 0.37) * df
+        x = np.where((np.arange(t) * tsamp * f_true) % 1.0 < 0.08,
+                     1.0, 0.0)
+        x = x + np.random.default_rng(20).normal(0, 0.3, t)
+        f_bin = round(f_true / df) * df     # what argmax-on-bins gives
+        grid = refine_grid(f_bin, tsamp, t, oversample=8)
+        h, _m, _p = epoch_folding_search(x, tsamp, grid, nbin=16, xp=np)
+        f_ref = grid[int(np.argmax(h))]
+        # refined to better than a grid step; the bin centre itself is
+        # 0.37 bins off, so this is a real improvement, not a tie
+        assert abs(f_ref - f_true) < df / 8 + 1e-9
+        assert abs(f_ref - f_true) < abs(f_bin - f_true)
+
+    def test_epoch_folding_fewer_samples_than_bins(self):
+        # nsamples < nbin: most phase bins receive zero hits — the
+        # exposure correction must not divide by zero and H must stay
+        # finite on both paths
+        rng = np.random.default_rng(21)
+        x = rng.normal(1.0, 0.1, 12)
+        grid = np.array([3.0, 5.0])
+        h, m, profs = epoch_folding_search(x, 0.01, grid, nbin=32,
+                                           xp=np)
+        assert profs.shape == (2, 32)
+        assert np.all(np.isfinite(h)) and np.all(m >= 1)
+        hj, mj, pj = epoch_folding_search(jnp.asarray(x, jnp.float32),
+                                          0.01, grid, nbin=32, xp=jnp)
+        assert np.all(np.isfinite(np.asarray(hj)))
+        np.testing.assert_allclose(np.asarray(pj).sum(axis=1),
+                                   profs.sum(axis=1), rtol=1e-4)
+
+    def test_epoch_folding_single_harmonic_nmax_clamp(self):
+        # nbin < 4 clamps the H-test harmonic scan to m = 1 (there is
+        # only one usable Fourier component), whatever nmax asks for
+        x = np.random.default_rng(22).normal(0, 1.0, 512)
+        _h, m, _p = epoch_folding_search(x, 0.01, np.array([2.0, 7.0]),
+                                         nbin=2, nmax=20, xp=np)
+        assert np.all(np.asarray(m) == 1)
+        # nbin=8 admits at most nbin//2 = 4 harmonics
+        _h, m, _p = epoch_folding_search(x, 0.01, np.array([2.0]),
+                                         nbin=8, nmax=100, xp=np)
+        assert np.all(np.asarray(m) <= 4)
+
+
 class TestEndToEnd:
     """Config-4 round trip: dispersed periodic pulsar -> dedisperse -> fold."""
 

@@ -44,6 +44,10 @@ MODULES = [
     "pulsarutils_tpu.parallel.sharded_fdmt",
     "pulsarutils_tpu.parallel.stream",
     "pulsarutils_tpu.parallel.multihost",
+    "pulsarutils_tpu.periodicity.accumulate",
+    "pulsarutils_tpu.periodicity.accel",
+    "pulsarutils_tpu.periodicity.candidates",
+    "pulsarutils_tpu.periodicity.driver",
     "pulsarutils_tpu.beams.batcher",
     "pulsarutils_tpu.beams.multibeam",
     "pulsarutils_tpu.beams.coincidence",

@@ -317,6 +317,16 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
         log(f"chaos drill: class {name}: "
             f"{'PASS' if rec['ok'] else 'FAIL ' + str(rec)}")
 
+    # periodicity workload (ISSUE 13): a transient device fault during
+    # full-observation accumulation, plus an interrupt-and-resume,
+    # must both leave the periodicity candidate artifact byte-identical
+    # to the fault-free job — the ledger records chunk completion and
+    # the accumulator snapshot advances in lockstep with it
+    log("chaos drill: class period_accumulation (recoverable)")
+    classes["period_accumulation"] = run_period_class(base_dir, log)
+    log(f"chaos drill: class period_accumulation: "
+        f"{'PASS' if classes['period_accumulation']['ok'] else 'FAIL'}")
+
     # torn ledger at resume: no FaultPlan — the fault is a truncated
     # progress file between two resumed sessions
     log("chaos drill: class torn_ledger (recoverable)")
@@ -359,6 +369,111 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     if not keep and workdir is None:
         shutil.rmtree(base_dir, ignore_errors=True)
     return result
+
+
+# ---------------------------------------------------------------------------
+# periodicity chaos class (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+#: the periodicity drill's own pulsar file: 60 Hz accelerated pulse
+#: train at DM 150 over 3 chunks (step 8192, hop 4096)
+PSR_F0 = 60.0
+PSR_ACCEL = 9.0e4
+PSR_NSAMPLES = 16384
+
+
+def make_pulsar_file(path):
+    """Deterministic accelerated-pulsar survey for the periodicity
+    class (a single-pulse file would make its byte-identity vacuous —
+    empty candidate lists compare equal for free).  The injection
+    physics lives in ONE place (``models.simulate``) shared with bench
+    config 17 and the tests."""
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import simulate_accel_pulsar_data
+
+    arr, hdr = simulate_accel_pulsar_data(
+        freq=PSR_F0, dm=DM, accel=PSR_ACCEL, tsamp=TSAMP,
+        nsamples=PSR_NSAMPLES, nchan=32, rng=7)
+    write_simulated_filterbank(path, arr, hdr, descending=True)
+    return path
+
+
+def _period_job(path, outdir, plan=None, cancel_cb=None):
+    from pulsarutils_tpu.periodicity.driver import periodicity_search
+
+    ctx = plan.armed() if plan is not None else contextlib.nullcontext()
+    with ctx:
+        return periodicity_search(
+            path, 130, 170, accel_max=1.8e5, n_accel=5,
+            sigma_threshold=8.0, chunk_length=4096 * TSAMP,
+            snr_threshold=8.0, output_dir=outdir, progress=False,
+            cancel_cb=cancel_cb)
+
+
+def _period_cands_bytes(res):
+    """The candidate artifact, member-by-member (the npz container
+    embeds timestamps; content comparison is the stable one)."""
+    with np.load(res["candidates_path"], allow_pickle=False) as data:
+        return {k: (str(data[k].dtype), data[k].shape,
+                    data[k].tobytes()) for k in data.files}
+
+
+def run_period_class(base_dir, log=print):
+    """The ISSUE 13 chaos class: transient fault during accumulation +
+    interrupt-and-resume, candidates byte-identical both ways."""
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+    from pulsarutils_tpu.pipeline.spectral_stats import get_bad_chans
+
+    t0 = time.time()
+    path = os.path.join(base_dir, "pulsar.fil")
+    make_pulsar_file(path)
+    get_bad_chans(path)
+
+    base = _period_job(path, os.path.join(base_dir, "period_baseline"))
+    assert base["complete"] and base["candidates"], \
+        "periodicity baseline found no candidates — class is vacuous"
+    base_bytes = _period_cands_bytes(base)
+
+    # leg 1: a transient device fault mid-accumulation (retried on the
+    # same backend, so the accumulated plane — and every downstream
+    # byte — must be identical)
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="error",
+                                chunks=(4096,), times=1)])
+    fault = _period_job(path, os.path.join(base_dir, "period_fault"),
+                        plan=plan)
+    fault_ok = (bool(plan.fired()) and fault["complete"]
+                and _period_cands_bytes(fault) == base_bytes)
+
+    # leg 2: interrupt after the first chunk, then resume — the ledger
+    # + accumulator snapshot must hand the resumed session exactly the
+    # remaining chunks and identical final bytes
+    outdir = os.path.join(base_dir, "period_resume")
+    seen = []
+
+    def cancel_after_one():
+        return len(seen) >= 1
+
+    from pulsarutils_tpu.periodicity.driver import periodicity_search
+
+    partial = periodicity_search(
+        path, 130, 170, accel_max=1.8e5, n_accel=5,
+        sigma_threshold=8.0, chunk_length=4096 * TSAMP,
+        snr_threshold=8.0, output_dir=outdir, progress=False,
+        cancel_cb=cancel_after_one, chunk_cb=seen.append)
+    resumed = _period_job(path, outdir)
+    resume_ok = (not partial["complete"] and resumed["complete"]
+                 and _period_cands_bytes(resumed) == base_bytes)
+
+    rec = {"recoverable": True, "fired": plan.fired(),
+           "hits": len(base["candidates"]),
+           "wall_s": round(time.time() - t0, 2),
+           "byte_identical": fault_ok and resume_ok,
+           "fault_leg_ok": fault_ok, "resume_leg_ok": resume_ok,
+           "partial_chunks": len(seen),
+           "best": {k: base["candidates"][0][k]
+                    for k in ("dm", "accel", "freq", "sigma")},
+           "ok": fault_ok and resume_ok}
+    return rec
 
 
 # ---------------------------------------------------------------------------
