@@ -50,6 +50,12 @@ all). Failures in one config don't stop the others.
      forced to 0.0 when any per-chunk table byte diverges or the
      putpu_bytes_uploaded_total ratio falls below 8x (expect ~16x at
      2 bits)
+ 18  distributed-observability A/B (ISSUE 14): a 2-worker fleet run
+     with tracing + metric time-series + SLO burn-rate alerting fully
+     armed vs fully off — value = off/on wall (the layer's measured
+     overhead), forced to 0.0 on any candidate/ledger byte divergence,
+     a merged trace missing a completing worker's spans, or zero SLO
+     evaluations
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -1253,11 +1259,187 @@ def config17(quick):
           "host_wall_s": round(host_wall, 3)})
 
 
+def config18(quick):
+    """Distributed-observability A/B (ISSUE 14): the same 2-file survey
+    run through a 2-worker fleet twice —
+
+    * **off arm** — the plain fleet (no tracing, no time-series, no
+      SLO engine), the pre-ISSUE-14 path;
+    * **on arm** — the whole layer armed: coordinator span tracer +
+      fleet trace collector, per-worker tracers draining spans over
+      the ``complete`` wire, per-worker time-series samplers scraped
+      by the coordinator sweep, and the default SLO set evaluating
+      burn rates on every sample.
+
+    ``value`` is the off/on wall ratio (the layer's measured overhead;
+    ~1.0 expected) — FORCED to 0.0, far past any tolerance, when any
+    candidate/ledger byte diverges between the arms, when the merged
+    trace is missing spans from any worker that completed units (or
+    the coordinator), or when zero SLO evaluations ran.
+    """
+    import glob
+    import tempfile
+    import threading
+
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.obs import trace as obs_trace
+    from pulsarutils_tpu.obs.collector import TraceCollector
+    from pulsarutils_tpu.obs.server import start_obs_server
+    from pulsarutils_tpu.obs.slo import SLOEngine
+    from pulsarutils_tpu.obs.timeseries import TimeSeriesSampler
+
+    tsamp, nchan = 0.0005, 64
+    hop = 4096 if quick else 8192
+    nhops = 6
+    nsamples = nhops * hop
+    config = dict(dmmin=100, dmmax=200, chunk_length=hop * tsamp,
+                  snr_threshold=6.5)
+    with tempfile.TemporaryDirectory() as tmp:
+        fnames = []
+        for i in range(2):
+            rng = np.random.default_rng(180 + i)
+            arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+            if i == 0:
+                arr[:, (3 * nsamples) // 4] += 4.0
+                arr = disperse_array(arr, 150.0, 1200., 200., tsamp)
+            header = {"bandwidth": 200., "fbottom": 1200.,
+                      "nchans": nchan, "nsamples": nsamples,
+                      "tsamp": tsamp, "foff": 200. / nchan}
+            path = os.path.join(tmp, f"survey{i}.fil")
+            write_simulated_filterbank(path, arr, header,
+                                       descending=True)
+            fnames.append(path)
+
+        def fleet_run(outdir, *, armed):
+            collector = tracer = sampler = engine = None
+            if armed:
+                collector = TraceCollector()
+                tracer = obs_trace.start_tracing()
+                engine = SLOEngine()
+                sampler = TimeSeriesSampler(
+                    interval_s=0.2,
+                    on_sample=lambda _p: engine.evaluate(sampler))
+                sampler.start()
+            t0 = time.time()
+            coordinator = FleetCoordinator(
+                outdir, lease_ttl_s=120.0, chunks_per_unit=1,
+                probe_interval_s=0.3, collector=collector)
+            server = start_obs_server(0, fleet=coordinator,
+                                      timeseries=sampler, slo=engine)
+            url = f"http://127.0.0.1:{server.port}"
+            coordinator.add_survey(fnames, **config)
+            workers = [FleetWorker(url, http_port=0 if armed else None,
+                                   trace=armed,
+                                   history_interval_s=0.2 if armed
+                                   else None)
+                       for _ in range(2)]
+            threads = [threading.Thread(target=w.run,
+                                        kwargs={"max_idle_s": 120.0})
+                       for w in workers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600.0)
+            wall = time.time() - t0
+            progress = coordinator.progress_doc()
+            summary = coordinator.summary()
+            server.close()
+            coordinator.close()
+            merged = None
+            if armed:
+                sampler.stop()
+                engine.evaluate(sampler)
+                engine.footer(log=__import__("logging").getLogger(
+                    "pulsarutils_tpu"))
+                obs_trace.stop_tracing()
+                collector.ingest_tracer("coordinator", tracer)
+                merged = collector.to_chrome()
+            return dict(wall=wall, progress=progress, summary=summary,
+                        workers=workers, merged=merged, engine=engine)
+
+        off = fleet_run(os.path.join(tmp, "off"), armed=False)
+        on = fleet_run(os.path.join(tmp, "on"), armed=True)
+
+        # identity: per-file ledger + candidate npz bytes between arms
+        # (the config-14 comparison rule)
+        identical = off["progress"]["survey_done"] \
+            and on["progress"]["survey_done"]
+        names = {os.path.basename(p)
+                 for d in ("off", "on")
+                 for p in glob.glob(os.path.join(tmp, d,
+                                                 "progress_*.json"))
+                 + glob.glob(os.path.join(tmp, d, "*.npz"))}
+        for name in sorted(names):
+            a_path = os.path.join(tmp, "off", name)
+            b_path = os.path.join(tmp, "on", name)
+            if not (os.path.exists(a_path) and os.path.exists(b_path)):
+                identical = False
+                log(f"config 18: {name} present in only one arm")
+                continue
+            if name.endswith(".json"):
+                with open(a_path, "rb") as fa, open(b_path, "rb") as fb:
+                    if fa.read() != fb.read():
+                        identical = False
+                        log(f"config 18: ledger bytes differ: {name}")
+            else:
+                with np.load(a_path, allow_pickle=False) as za, \
+                        np.load(b_path, allow_pickle=False) as zb:
+                    if set(za.files) != set(zb.files) or any(
+                            za[k].tobytes() != zb[k].tobytes()
+                            for k in za.files):
+                        identical = False
+                        log(f"config 18: candidate bytes differ: {name}")
+
+        # the merged trace must hold spans from the coordinator AND
+        # every worker that completed units, sharing trace ids
+        merged = on["merged"]
+        span_pids = {}
+        pid_names = {}
+        for ev in merged["traceEvents"]:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_names[ev["pid"]] = ev["args"]["name"]
+            if ev.get("ph") in ("X", "b") \
+                    and ev.get("name") != "clock_sync":
+                span_pids.setdefault(ev["pid"], 0)
+                span_pids[ev["pid"]] += 1
+        traced = {pid_names.get(pid) for pid in span_pids}
+        needed = {"coordinator"} | {
+            f"worker {w.worker_id}" for w in on["workers"]
+            if w.units_done > 0}
+        trace_ok = needed <= traced
+        if not trace_ok:
+            log(f"config 18: merged trace missing spans: needed "
+                f"{sorted(needed)}, traced {sorted(t for t in traced if t)}")
+        evaluations = on["engine"].alerts_doc()["evaluations"]
+        slo_ok = evaluations > 0
+        history = on["summary"].get("history") or {}
+        ok = identical and trace_ok and slo_ok
+    emit({"config": 18, "metric": "distributed observability A/B: "
+          "2-worker fleet with tracing+timeseries+SLO armed vs off, "
+          f"2 files x {nchan}x{nsamples}",
+          "value": round(off["wall"] / on["wall"], 4) if ok else 0.0,
+          "unit": "x (off/on wall; 0 = byte divergence, missing "
+                  "worker spans, or zero SLO evaluations)",
+          "identical": identical,
+          "trace_ok": trace_ok,
+          "traced_processes": sorted(t for t in traced if t),
+          "slo_evaluations": evaluations,
+          "alerts_fired": on["engine"].alerts_doc()
+          ["alerts_fired_total"],
+          "workers_with_history": sorted(history),
+          "units_per_worker": [w.units_done for w in on["workers"]],
+          "off_wall_s": round(off["wall"], 2),
+          "on_wall_s": round(on["wall"], 2)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13, 14, 15, 16, 17])
+                                 13, 14, 15, 16, 17, 18])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -1286,7 +1468,7 @@ def main(argv=None):
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16, 17: config17}
+           15: config15, 16: config16, 17: config17, 18: config18}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

@@ -78,7 +78,13 @@ CONTAINMENT_SEAMS = {
     # reviewed fleet containment seam (ISSUE 9; the coordinator's HTTP
     # handlers ride the already-seamed obs/server do_GET/do_POST, and
     # the drain path catches only (OSError, ValueError) narrowly)
-    ("fleet/worker.py", "FleetWorker._run_unit"),
+    ("fleet/worker.py", "FleetWorker._run_unit_inner"),
+    # the time-series sampler's spill/hook and its background loop:
+    # metric history is observability — a failed sample, JSONL spill or
+    # SLO evaluation hook must log and move on, never kill a run
+    # (ISSUE 14)
+    ("obs/timeseries.py", "TimeSeriesSampler.sample"),
+    ("obs/timeseries.py", "TimeSeriesSampler._loop"),
     # the periodicity trial sweep's device->host fallback (ISSUE 13):
     # re-raises (ValueError, TypeError) first, then degrades a failed
     # jax dispatch to the numpy reference path — the same ladder-floor

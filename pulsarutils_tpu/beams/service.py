@@ -41,6 +41,7 @@ import time
 from ..io.candidates import CandidateStore
 from ..io.sigproc import read_header
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..obs.health import HealthEngine
 from ..utils.logging_utils import logger
 
@@ -152,6 +153,11 @@ class _Job:
         #: batchability key, computed ONCE at submit (the header read
         #: must not repeat under the service lock on every batch pop)
         self.geom_tag = geom_tag
+        #: distributed-trace identity (ISSUE 14): every span the job's
+        #: run records carries this id, so one ``/jobs`` submission is
+        #: one causal timeline in the trace
+        self.trace_id = _trace.new_trace_id()
+        self.span = None       # async "job" span, open while running
         self.state = QUEUED
         self.error = None
         self.submitted_at = time.time()
@@ -170,6 +176,7 @@ class _Job:
         """The JSON document GET /jobs/<id> serves."""
         return {
             "id": self.id, "state": self.state, "spec": dict(self.spec),
+            "trace_id": self.trace_id,
             "output_dir": self.output_dir, "error": self.error,
             "submitted_at": round(self.submitted_at, 3),
             "started_at": (round(self.started_at, 3)
@@ -332,6 +339,9 @@ class SurveyService:
         job.state = state
         job.error = error
         job.finished_at = time.time()
+        if job.span is not None:
+            job.span.end(outcome=state)
+            job.span = None
         _metrics.counter("putpu_jobs_finished_total", status=state).inc()
 
     def _admission_cap(self, job):
@@ -422,6 +432,17 @@ class SurveyService:
                 job.state = RUNNING
                 job.started_at = time.time()
                 job.batch_group = list(batch)
+                # one async "job" span per tenant under its OWN
+                # trace_id (co-batched tenants share the batch's driver
+                # spans — recorded under the lead job's context — but
+                # each job's lifetime is its own span).  Ends in
+                # _finish_locked; a free no-op handle when tracing is
+                # off.
+                with _trace.trace_context(job.trace_id):
+                    # putpu-lint: disable=span-leak — ends at the job's terminal transition (_finish_locked), tracked on the _Job
+                    job.span = _trace.begin_span(
+                        "job", track="service", job=job.id,
+                        fname=os.path.basename(job.spec["fname"]))
             return batch
 
     def _run(self):
@@ -471,11 +492,12 @@ class SurveyService:
         if "period_sigma_threshold" in spec:
             kwargs["sigma_threshold"] = spec["period_sigma_threshold"]
         try:
-            res = periodicity_search(
-                spec["fname"], spec["dmmin"], spec["dmmax"],
-                output_dir=self.output_dir, resume=self.resume,
-                cancel_cb=job.cancel_event.is_set, chunk_cb=chunk_cb,
-                health=job.health, progress=False, **kwargs)
+            with _trace.trace_context(job.trace_id):
+                res = periodicity_search(
+                    spec["fname"], spec["dmmin"], spec["dmmax"],
+                    output_dir=self.output_dir, resume=self.resume,
+                    cancel_cb=job.cancel_event.is_set, chunk_cb=chunk_cb,
+                    health=job.health, progress=False, **kwargs)
         except Exception as exc:  # one bad job must not kill the service worker
             logger.error("periodicity job %s failed: %r", job.id, exc)
             with self._lock:
@@ -540,12 +562,17 @@ class SurveyService:
 
         kwargs = {k: spec[k] for k in _FORWARD_KEYS if k in spec}
         try:
-            result = multibeam_search(
-                [j.spec["fname"] for j in jobs], spec["dmmin"],
-                spec["dmmax"], resume=self.resume,
-                output_dir=self.output_dir, cancel_cb=cancel_cb,
-                progress_cb=progress_cb, store_factory=store_factory,
-                **kwargs)
+            # the batched run's driver spans record under the LEAD
+            # job's trace context (one device program serves N
+            # tenants: its spans cannot belong to all of them; the
+            # per-job "job" spans carry each tenant's own id)
+            with _trace.trace_context(jobs[0].trace_id):
+                result = multibeam_search(
+                    [j.spec["fname"] for j in jobs], spec["dmmin"],
+                    spec["dmmax"], resume=self.resume,
+                    output_dir=self.output_dir, cancel_cb=cancel_cb,
+                    progress_cb=progress_cb, store_factory=store_factory,
+                    **kwargs)
         except Exception as exc:  # one bad batch must not kill the service worker
             logger.error("job batch %s failed: %r", batch, exc)
             with self._lock:

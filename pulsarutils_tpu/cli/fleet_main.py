@@ -71,6 +71,22 @@ def build_parser():
     coord.add_argument("--exit-when-done", action="store_true",
                        help="exit once every unit is resolved (default: "
                             "keep serving so more surveys can be added)")
+    coord.add_argument("--trace-out", default=None,
+                       help="write ONE merged Perfetto trace: the "
+                            "coordinator's spans plus every traced "
+                            "worker's, clock-skew corrected (workers "
+                            "must run with --trace-out or in-process "
+                            "trace=True to contribute)")
+    coord.add_argument("--history-interval", type=float, default=None,
+                       metavar="S",
+                       help="sample the coordinator registry into the "
+                            "/metrics/history ring every S seconds")
+    coord.add_argument("--slo", action="store_true",
+                       help="arm the default SLO set (dispatch success, "
+                            "chunk-wall p95, canary recall, lease "
+                            "success) with burn-rate alerting: /alerts "
+                            "endpoint + ALERTS_JSON footer (implies "
+                            "--history-interval 5 when unset)")
 
     work = sub.add_parser("worker",
                           help="lease and search units from a "
@@ -90,11 +106,23 @@ def build_parser():
     work.add_argument("--max-idle", type=float, default=None,
                       help="exit after this many seconds with nothing "
                            "to lease (default: poll forever)")
+    work.add_argument("--trace-out", default=None,
+                      help="arm span tracing: unit spans bind each "
+                           "lease's trace_id, drain to the coordinator "
+                           "per completion, AND export this worker's "
+                           "own trace JSON here at exit (mergeable "
+                           "post-hoc with tools/trace_merge.py)")
+    work.add_argument("--history-interval", type=float, default=None,
+                      metavar="S",
+                      help="sample this worker's registry every S "
+                           "seconds; serves /metrics/history, which "
+                           "the coordinator scrapes for fleet trends")
     return parser
 
 
 def _run_coordinator(opts):
     from ..fleet.coordinator import FleetCoordinator
+    from ..obs import trace as obs_trace
     from ..obs.server import start_obs_server
 
     config = {"dmmin": opts.dmmin, "dmmax": opts.dmmax}
@@ -108,13 +136,43 @@ def _run_coordinator(opts):
     if opts.chunk_length is not None:
         config["chunk_length"] = opts.chunk_length
 
+    # distributed observability (ISSUE 14), armed only on request
+    collector = tracer = sampler = engine = health = None
+    if opts.trace_out:
+        from ..obs.collector import TraceCollector
+
+        collector = TraceCollector()
+        tracer = obs_trace.start_tracing()
+    history_interval = opts.history_interval
+    if opts.slo and history_interval is None:
+        history_interval = 5.0
+    if history_interval is not None:
+        from ..obs.timeseries import TimeSeriesSampler
+
+        if opts.slo:
+            from ..obs.health import HealthEngine
+            from ..obs.slo import SLOEngine
+
+            # burn alerts FEED the coordinator's health verdict: a
+            # paged SLO turns /healthz CRITICAL, so dumb probes act on
+            # budget burn with zero parsing (the documented contract)
+            health = HealthEngine()
+            engine = SLOEngine(health=health)
+            sampler = TimeSeriesSampler(
+                interval_s=history_interval,
+                on_sample=lambda _p: engine.evaluate(sampler))
+        else:
+            sampler = TimeSeriesSampler(interval_s=history_interval)
+        sampler.start()
+
     coordinator = FleetCoordinator(
         opts.output_dir, lease_ttl_s=opts.lease_ttl,
         chunks_per_unit=opts.chunks_per_unit,
         probe_interval_s=opts.probe_interval,
-        resume=not opts.no_resume)
+        resume=not opts.no_resume, collector=collector)
     server = start_obs_server(opts.http_port, host=opts.http_host,
-                              fleet=coordinator)
+                              fleet=coordinator, timeseries=sampler,
+                              slo=engine, health=health)
     logger.info("fleet coordinator on http://%s:%d — workers: "
                 "PUfleet worker --coordinator http://%s:%d",
                 opts.http_host, server.port, opts.http_host, server.port)
@@ -131,6 +189,16 @@ def _run_coordinator(opts):
         summary = coordinator.summary()
         server.close()
         coordinator.close()
+        if sampler is not None:
+            sampler.stop()
+        if engine is not None:
+            if sampler is not None:
+                engine.evaluate(sampler)
+            engine.footer()
+        if collector is not None:
+            obs_trace.stop_tracing()
+            collector.ingest_tracer("coordinator", tracer)
+            collector.export(opts.trace_out)
     print(json.dumps({"fleet": summary}))
     if opts.report_out:
         from ..obs import metrics as obs_metrics
@@ -141,6 +209,7 @@ def _run_coordinator(opts):
                            "files": len(opts.fnames),
                            "output_dir": os.path.abspath(opts.output_dir)},
                      fleet=summary,
+                     slo=engine.to_json() if engine is not None else None,
                      metrics=obs_metrics.REGISTRY.snapshot())
         logger.info("fleet report -> %s.md", opts.report_out)
     return 0 if summary["survey_done"] else 1
@@ -152,11 +221,18 @@ def _run_worker(opts):
     worker = FleetWorker(opts.coordinator, worker_id=opts.worker_id,
                          http_port=opts.http_port,
                          http_host=opts.http_host,
-                         max_units=opts.max_units)
+                         max_units=opts.max_units,
+                         trace=bool(opts.trace_out),
+                         history_interval_s=opts.history_interval)
     worker.install_signal_handlers()
     units = worker.run(max_idle_s=opts.max_idle)
+    if opts.trace_out and worker.tracer is not None:
+        worker.tracer.export(
+            opts.trace_out,
+            extra_meta={"clock_offset_s": worker.clock_offset_s})
     print(json.dumps({"worker": worker.worker_id, "units_done": units,
-                      "drained": worker.drained}))
+                      "drained": worker.drained,
+                      "clock_offset_s": round(worker.clock_offset_s, 6)}))
     return 0
 
 

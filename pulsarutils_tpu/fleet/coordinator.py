@@ -41,10 +41,16 @@ import threading
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..utils.logging_utils import logger
 from . import protocol
 
 __all__ = ["FleetCoordinator"]
+
+#: series the fleet report plots per worker over time (ISSUE 14):
+#: throughput, device headroom and science recall — trends, not finals
+_HISTORY_SERIES = ("putpu_chunks_per_s", "putpu_device_headroom_bytes",
+                   "putpu_canary_recall")
 
 #: lease/steal failure matrix states (documented in docs/fleet.md)
 _TERMINAL = ("done", "failed")
@@ -52,9 +58,12 @@ _TERMINAL = ("done", "failed")
 
 class _Unit:
     """One leasable work unit: a chunk range of one file.  ``chunks``
-    only ever shrinks (grant-time ledger check drops finished ones)."""
+    only ever shrinks (grant-time ledger check drops finished ones).
+    ``trace_id`` is the unit's distributed-trace identity (ISSUE 14):
+    every lease of this unit — across steals and requeues — carries the
+    same id, so the merged trace shows ONE causal timeline per unit."""
 
-    __slots__ = ("id", "fname", "chunks", "attempts", "state")
+    __slots__ = ("id", "fname", "chunks", "attempts", "state", "trace_id")
 
     def __init__(self, unit_id, fname, chunks):
         self.id = unit_id
@@ -62,15 +71,17 @@ class _Unit:
         self.chunks = tuple(int(c) for c in chunks)
         self.attempts = 0
         self.state = "pending"      # pending | leased | done | failed
+        self.trace_id = _trace.new_trace_id()
 
     def doc(self):
         return {"unit": self.id, "fname": self.fname,
                 "chunks": list(self.chunks), "state": self.state,
-                "attempts": self.attempts}
+                "attempts": self.attempts, "trace_id": self.trace_id}
 
 
 class _Lease:
-    __slots__ = ("id", "unit_id", "worker_id", "expires_at", "granted_at")
+    __slots__ = ("id", "unit_id", "worker_id", "expires_at", "granted_at",
+                 "span")
 
     def __init__(self, lease_id, unit_id, worker_id, expires_at):
         self.id = lease_id
@@ -78,12 +89,15 @@ class _Lease:
         self.worker_id = worker_id
         self.expires_at = expires_at      # monotonic deadline
         self.granted_at = time.time()
+        #: the coordinator-side AsyncSpan bracketing grant -> resolution
+        #: (a no-op handle when coordinator tracing is off)
+        self.span = None
 
 
 class _WorkerRec:
     __slots__ = ("id", "healthz_url", "verdict", "probe_failures",
                  "alive", "draining", "last_seen", "units_completed",
-                 "metrics", "registered_at", "mem_budget")
+                 "metrics", "registered_at", "mem_budget", "history")
 
     def __init__(self, worker_id, healthz_url, mem_budget=None):
         self.id = worker_id
@@ -99,6 +113,9 @@ class _WorkerRec:
         #: worker-reported device memory budget in bytes (ISSUE 12):
         #: None = unreported, leases are sized by chunks_per_unit alone
         self.mem_budget = mem_budget
+        #: last scraped /metrics/history document (ISSUE 14); None =
+        #: never scraped / worker serves no sampler
+        self.history = None
 
     def doc(self, held):
         return {"worker": self.id, "healthz_url": self.healthz_url,
@@ -134,9 +151,18 @@ class FleetCoordinator:
     def __init__(self, output_dir, *, lease_ttl_s=30.0, chunks_per_unit=1,
                  probe_interval_s=1.0, probe_timeout_s=2.0, dead_after=3,
                  poll_s=0.25, resume=True, file_affinity=True,
-                 max_attempts=5, auto_sweep=True):
+                 max_attempts=5, auto_sweep=True, collector=None,
+                 scrape_history=True):
         self.output_dir = str(output_dir)
         os.makedirs(self.output_dir, exist_ok=True)
+        #: a :class:`~pulsarutils_tpu.obs.collector.TraceCollector` (or
+        #: None): wired, every completion's drained worker spans are
+        #: stitched into the fleet trace (ISSUE 14)
+        self.collector = collector
+        #: scrape each probed worker's /metrics/history on the sweep so
+        #: the fleet report shows per-worker trends (workers without a
+        #: sampler 404 harmlessly)
+        self.scrape_history = bool(scrape_history)
         self.lease_ttl_s = float(lease_ttl_s)
         self.chunks_per_unit = max(int(chunks_per_unit), 1)
         self.probe_interval_s = float(probe_interval_s)
@@ -153,6 +179,7 @@ class FleetCoordinator:
         self._workers = {}        # worker_id -> _WorkerRec
         self._files = {}          # fname -> {"fingerprint", "config", ...}
         self._seq = {"unit": 0, "lease": 0, "worker": 0}
+        self._trace_seqs = {}     # worker id -> last ingested trace seq
         self._stats = {"granted": 0, "expired": 0, "revoked": 0,
                        "denied": 0, "requeued": 0, "completed": 0,
                        "failed": 0, "duplicates": 0}
@@ -406,7 +433,10 @@ class FleetCoordinator:
                     f"{mem_budget} B" if mem_budget else "unreported")
         return {"worker": worker_id, "lease_ttl_s": self.lease_ttl_s,
                 "poll_s": self.poll_s,
-                "protocol_version": protocol.PROTOCOL_VERSION}
+                "protocol_version": protocol.PROTOCOL_VERSION,
+                # the clock-sync anchor (ISSUE 14): the worker computes
+                # its offset by the midpoint rule; old workers ignore it
+                "server_time": time.time()}
 
     def lease(self, doc):
         """``lease`` message: grant up to ``max_units`` pending units.
@@ -440,7 +470,8 @@ class FleetCoordinator:
             if worker.draining or self._closed:
                 return {"leases": [], "denied": "draining",
                         "survey_done": self._survey_done_locked(),
-                        "poll_s": self.poll_s}
+                        "poll_s": self.poll_s,
+                        "server_time": time.time()}
             if worker.verdict in ("DEGRADED", "CRITICAL"):
                 self._stats["denied"] += 1
                 _metrics.counter("putpu_fleet_leases_denied_total").inc()
@@ -448,12 +479,14 @@ class FleetCoordinator:
                             worker_id, worker.verdict)
                 return {"leases": [], "denied": worker.verdict,
                         "survey_done": self._survey_done_locked(),
-                        "poll_s": self.poll_s}
+                        "poll_s": self.poll_s,
+                        "server_time": time.time()}
             granted = self._grant_locked(worker, max_units, done_cache)
             self._update_gauges_locked()
             return {"leases": granted, "denied": None,
                     "survey_done": self._survey_done_locked(),
-                    "poll_s": self.poll_s}
+                    "poll_s": self.poll_s,
+                    "server_time": time.time()}
 
     def _note_report_locked(self, worker, doc):
         """Fold a message's optional self-reported ``metrics`` snapshot
@@ -540,6 +573,18 @@ class FleetCoordinator:
             self._seq["lease"] += 1
             lease = _Lease(f"L{self._seq['lease']}", unit_id, worker.id,
                            time.monotonic() + self.lease_ttl_s)
+            # the coordinator side of the unit's causal timeline: an
+            # async span bracketing grant -> resolution, recorded under
+            # the unit's trace_id (a free no-op handle when coordinator
+            # tracing is off).  Ends in _end_lease_span_locked — a
+            # reviewed cross-method seam.
+            with _trace.trace_context(unit.trace_id):
+                # putpu-lint: disable=span-leak — ends at lease resolution (complete/expiry/revoke/release), tracked on the _Lease
+                lease.span = _trace.begin_span(
+                    "lease", track=f"worker {worker.id}",
+                    lease=lease.id, unit=unit.id, worker=worker.id,
+                    fname=os.path.basename(unit.fname),
+                    chunks=len(unit.chunks))
             self._leases[lease.id] = lease
             busy.setdefault(unit.fname, worker.id)
             self._stats["granted"] += 1
@@ -549,8 +594,21 @@ class FleetCoordinator:
                 "lease": lease.id, "unit": unit.id, "fname": unit.fname,
                 "chunks": list(unit.chunks), "config": rec["config"],
                 "output_dir": self.output_dir,
-                "expires_in_s": self.lease_ttl_s})
+                "expires_in_s": self.lease_ttl_s,
+                # distributed-trace stamp (ISSUE 14): the worker binds
+                # this so its chunk/dispatch/persist spans share the
+                # unit's trace_id; old workers simply ignore the key
+                "trace": {"trace_id": unit.trace_id,
+                          **({"parent_span_id": str(lease.span._id)}
+                             if isinstance(lease.span, _trace.AsyncSpan)
+                             else {})}})
         return granted
+
+    def _end_lease_span_locked(self, lease, outcome):
+        """Close a lease's coordinator-side span with its outcome (safe
+        on the no-op handle; idempotent like AsyncSpan.end)."""
+        if lease.span is not None:
+            lease.span.end(outcome=outcome)
 
     def complete(self, doc):
         """``complete`` message: resolve a finished (or failed) unit.
@@ -567,6 +625,29 @@ class FleetCoordinator:
         lease_id = str(protocol.require(doc, "lease", str, "complete"))
         unit_id = str(protocol.require(doc, "unit", str, "complete"))
         error = doc.get("error")
+        # stitch the worker's drained spans into the fleet trace; an
+        # absent "trace" key is the old-worker back-compat path.  The
+        # payload's ``seq`` makes this idempotent: a wire-level resend
+        # of the same complete message (lost response -> retry) must
+        # not render every span twice in the merged trace — the ledger
+        # path is idempotent against exactly that retry, so the trace
+        # path must be too.  The ingest itself runs OUTSIDE the
+        # coordinator lock (the collector has its own).
+        trace_doc = doc.get("trace") if self.collector is not None \
+            else None
+        if isinstance(trace_doc, dict):
+            fresh = True
+            with self._lock:
+                if worker_id not in self._workers:
+                    fresh = False
+                seq = trace_doc.get("seq")
+                if fresh and isinstance(seq, (int, float)):
+                    last = self._trace_seqs.get(worker_id)
+                    fresh = last is None or seq > last
+                    if fresh:
+                        self._trace_seqs[worker_id] = seq
+            if fresh:
+                self.collector.ingest(f"worker {worker_id}", trace_doc)
         done_cache = {}
         with self._lock:
             worker = self._workers.get(worker_id)
@@ -579,6 +660,8 @@ class FleetCoordinator:
             lease = self._leases.get(lease_id)
             if lease is not None and lease.unit_id == unit_id:
                 del self._leases[lease_id]
+                self._end_lease_span_locked(
+                    lease, "completed" if error is None else "error")
             else:
                 # the lease was already expired/revoked and possibly
                 # re-granted: the straggler finished anyway.  Its ledger
@@ -652,6 +735,7 @@ class FleetCoordinator:
                 lease = self._leases.pop(str(lease_id), None)
                 if lease is None or lease.worker_id != worker_id:
                     continue
+                self._end_lease_span_locked(lease, f"released:{reason}")
                 unit = self._units[lease.unit_id]
                 if too_large and len(unit.chunks) > 1 \
                         and self._files[unit.fname].get("workload") \
@@ -746,6 +830,7 @@ class FleetCoordinator:
             for lease_id, lease in list(self._leases.items()):
                 if lease.expires_at <= now:
                     del self._leases[lease_id]
+                    self._end_lease_span_locked(lease, "expired")
                     unit = self._units[lease.unit_id]
                     self._stats["expired"] += 1
                     _metrics.counter(
@@ -759,14 +844,23 @@ class FleetCoordinator:
                              for w in self._workers.values()
                              if w.alive and w.healthz_url]
         probes = {}
+        histories = {}
         for worker_id, url in probe_targets:   # IO outside the lock
             probes[worker_id] = self._probe_one(url)
+            if self.scrape_history and probes[worker_id] is not None:
+                # same sweep, same live surface: the worker's metric
+                # time-series rides back beside its verdict, so the
+                # fleet report gets per-worker trends (ISSUE 14).
+                # Workers without a sampler 404 -> None, harmless.
+                histories[worker_id] = self._scrape_history_one(url)
         revoked = []
         with self._lock:
             for worker_id, verdict in probes.items():
                 worker = self._workers.get(worker_id)
                 if worker is None or not worker.alive:
                     continue
+                if histories.get(worker_id) is not None:
+                    worker.history = histories[worker_id]
                 if verdict is None:
                     worker.probe_failures += 1
                     if worker.probe_failures >= self.dead_after:
@@ -798,12 +892,30 @@ class FleetCoordinator:
         except (OSError, ValueError, http.client.HTTPException):
             return None
 
+    def _scrape_history_one(self, healthz_url):
+        """One ``/metrics/history`` scrape off the worker's live
+        surface; ``None`` when the worker serves no sampler (404) or
+        the transport failed — history is a trend view, never worth a
+        failed sweep."""
+        base = healthz_url[: -len("/healthz")] \
+            if healthz_url.endswith("/healthz") else healthz_url
+        try:
+            status, doc = protocol.get_json(
+                base + "/metrics/history?last=64",
+                timeout=self.probe_timeout_s)
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        if status != 200 or not isinstance(doc.get("samples"), list):
+            return None
+        return doc
+
     def _revoke_worker_locked(self, worker_id, done_cache, why):
         revoked = []
         for lease_id, lease in list(self._leases.items()):
             if lease.worker_id != worker_id:
                 continue
             del self._leases[lease_id]
+            self._end_lease_span_locked(lease, f"revoked:{why}")
             self._stats["revoked"] += 1
             _metrics.counter("putpu_fleet_leases_revoked_total").inc()
             self._requeue_locked(self._units[lease.unit_id], done_cache,
@@ -911,6 +1023,29 @@ class FleetCoordinator:
             lines.append(f"{name}{label_str} {value}")
         return "\n".join(lines) + "\n"
 
+    def fleet_history_doc(self):
+        """``GET /fleet/history``: every worker's last scraped
+        ``/metrics/history`` ring, keyed by worker id (ISSUE 14)."""
+        with self._lock:
+            return {"workers": {w.id: w.history
+                                for w in sorted(self._workers.values(),
+                                                key=lambda w: w.id)
+                                if w.history is not None}}
+
+    @staticmethod
+    def _compact_history(history):
+        """``{series: [[t, value], ...]}`` for the report's trend
+        plots, pulled from one worker's scraped history doc."""
+        out = {}
+        for point in history.get("samples", ()):
+            for name in _HISTORY_SERIES:
+                rec = (point.get("series") or {}).get(name)
+                if rec is None or rec.get("value") is None:
+                    continue
+                out.setdefault(name, []).append(
+                    [point["t"], rec["value"]])
+        return out
+
     def summary(self):
         """Condensed end-of-run record (the survey report's fleet
         section and the CLI's final log line)."""
@@ -918,13 +1053,22 @@ class FleetCoordinator:
         with self._lock:
             workers = [w.doc(0) for w in sorted(self._workers.values(),
                                                 key=lambda w: w.id)]
-        return {"chunks_total": doc["chunks_total"],
-                "chunks_done": doc["chunks_done"],
-                "units": doc["units"], "stats": doc["stats"],
-                "survey_done": doc["survey_done"],
-                "workers": [{k: w[k] for k in
-                             ("worker", "verdict", "alive",
-                              "units_completed")} for w in workers]}
+            history = {w.id: self._compact_history(w.history)
+                       for w in self._workers.values()
+                       if w.history is not None}
+        out = {"chunks_total": doc["chunks_total"],
+               "chunks_done": doc["chunks_done"],
+               "units": doc["units"], "stats": doc["stats"],
+               "survey_done": doc["survey_done"],
+               "workers": [{k: w[k] for k in
+                            ("worker", "verdict", "alive",
+                             "units_completed")} for w in workers]}
+        if any(history.values()):
+            # per-worker metric trends (ISSUE 14): the report plots
+            # chunks/s, headroom and recall over time, not just finals
+            out["history"] = {k: v for k, v in sorted(history.items())
+                              if v}
+        return out
 
     @property
     def survey_done(self):

@@ -47,6 +47,24 @@ Design rules:
 Version negotiation is deliberately blunt: ``register`` returns
 :data:`PROTOCOL_VERSION` and the worker refuses a mismatch — the PR 5
 snapshot-schema rule, applied to the wire.
+
+Distributed tracing rides the same wire (ISSUE 14), with **absent-field
+back-compat** instead of a version bump — every trace field is
+optional, so an old worker against a new coordinator (and vice versa)
+keeps working, just untraced:
+
+* ``register``/``lease`` responses carry ``server_time`` (the
+  coordinator's wall clock while handling) — the worker computes its
+  clock offset by the midpoint rule
+  (:func:`~pulsarutils_tpu.obs.collector.clock_offset`);
+* each granted lease carries ``trace`` — the :data:`TRACE_KEYS` subset
+  (``trace_id`` + the coordinator's ``parent_span_id``), validated by
+  :func:`clean_trace_context` like ``SEARCH_KEYS`` validates search
+  config: an unknown key fails loudly at the seam, never silently
+  forks what a trace means;
+* ``complete`` may carry ``trace`` — the worker's drained span events
+  (``events``/``tracks``/``epoch_unix``/``clock_offset_s``) for the
+  coordinator's :class:`~pulsarutils_tpu.obs.collector.TraceCollector`.
 """
 
 from __future__ import annotations
@@ -58,11 +76,18 @@ import time
 import urllib.error
 import urllib.request
 
-__all__ = ["PROTOCOL_VERSION", "SEARCH_KEYS", "TRANSIENT_WIRE_ERRORS",
-           "clean_search_config", "get_json", "post_json",
+__all__ = ["PROTOCOL_VERSION", "SEARCH_KEYS", "TRACE_KEYS",
+           "TRANSIENT_WIRE_ERRORS", "clean_search_config",
+           "clean_trace_context", "get_json", "post_json",
            "post_json_retry", "require"]
 
 PROTOCOL_VERSION = 1
+
+#: the trace-context fields a lease may carry (ISSUE 14) — the
+#: SEARCH_KEYS rule applied to tracing: the allowed set is written
+#: down, and an unknown key fails at the seam.  Absent entirely =
+#: untraced lease (old-coordinator back-compat).
+TRACE_KEYS = ("trace_id", "parent_span_id")
 
 #: transport failures worth one more try: a flaky connect, a reset
 #: socket, a timed-out read.  ``urllib.error.URLError`` wraps most
@@ -118,6 +143,29 @@ def clean_search_config(config):
     return json.loads(json.dumps(out))
 
 
+def clean_trace_context(ctx):
+    """Validate a lease's ``trace`` field; returns a plain dict (or
+    ``None`` for an absent/null context — the untraced back-compat
+    path).  Raises ``ValueError`` on unknown keys or non-string values:
+    a malformed context must fail at the seam, not produce a trace
+    whose ids silently mean something else."""
+    if ctx is None:
+        return None
+    if not isinstance(ctx, dict):
+        raise ValueError("trace context must be a JSON object or null")
+    unknown = sorted(set(ctx) - set(TRACE_KEYS))
+    if unknown:
+        raise ValueError(f"trace context keys {unknown} are not in "
+                         f"{sorted(TRACE_KEYS)}")
+    if not isinstance(ctx.get("trace_id"), str) or not ctx["trace_id"]:
+        raise ValueError("trace context needs a non-empty string "
+                         "trace_id")
+    parent = ctx.get("parent_span_id")
+    if parent is not None and not isinstance(parent, str):
+        raise ValueError("parent_span_id must be a string or absent")
+    return {k: ctx[k] for k in TRACE_KEYS if ctx.get(k) is not None}
+
+
 def require(doc, key, types, what="message"):
     """Fetch ``doc[key]`` asserting its type; ``ValueError`` otherwise
     (the HTTP layer maps that to a 400)."""
@@ -154,7 +202,7 @@ def post_json(url, doc, timeout=10.0):
 
 
 def post_json_retry(url, doc, timeout=10.0, retries=3, backoff_s=0.2,
-                    jitter_s=0.1):
+                    jitter_s=0.1, timing=None):
     """:func:`post_json` with bounded retry on transient transport
     failures (ISSUE 12 satellite: one flaky connect used to fail the
     whole register/lease/complete/release call).
@@ -165,13 +213,24 @@ def post_json_retry(url, doc, timeout=10.0, retries=3, backoff_s=0.2,
     the final failure propagates unchanged.  HTTP status errors
     (``ValueError``) are never retried — they are protocol answers,
     not transport weather.
+
+    ``timing`` (a dict, ISSUE 14) receives ``t0``/``t1`` wall-clock
+    stamps bracketing the SUCCESSFUL attempt only — the clock-offset
+    midpoint rule needs one request–response exchange, and a window
+    inflated by failed attempts + backoff would corrupt the offset by
+    half the retry time.
     """
     from ..obs import metrics as _metrics
 
     last = None
     for attempt in range(max(int(retries), 0) + 1):
         try:
-            return post_json(url, doc, timeout=timeout)
+            t0 = time.time()
+            out = post_json(url, doc, timeout=timeout)
+            if timing is not None:
+                timing["t0"] = t0
+                timing["t1"] = time.time()
+            return out
         except ValueError:
             raise  # HTTP status: the server answered; do not re-ask
         except TRANSIENT_WIRE_ERRORS as exc:

@@ -38,8 +38,12 @@ import signal
 import threading
 import time
 
+import contextlib
+
 from ..faults import inject as fault_inject
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+from ..obs.collector import clock_offset
 from ..obs.health import HealthEngine
 from ..obs.server import start_obs_server
 from ..utils.logging_utils import logger
@@ -62,11 +66,20 @@ class FleetWorker:
     knobs (e.g. ``dispatch_timeout``); science keys arrive via the
     lease and overriding them would fork the ledger fingerprint, so
     don't.
+
+    Observability knobs (ISSUE 14, both default-off and byte-inert):
+    ``trace=True`` arms this worker's own span tracer — unit spans
+    bind each lease's ``trace_id`` and drain to the coordinator's
+    trace collector in every ``complete``; ``history_interval_s`` arms
+    the metric time-series sampler behind ``/metrics/history``, which
+    the coordinator's sweep scrapes for the fleet report's per-worker
+    trends.
     """
 
     def __init__(self, coordinator_url, *, worker_id=None, http_port=0,
                  http_host="127.0.0.1", max_units=1, poll_s=None,
-                 health=None, search_overrides=None):
+                 health=None, search_overrides=None, trace=False,
+                 history_interval_s=None):
         self.coordinator_url = coordinator_url.rstrip("/")
         self.requested_id = worker_id
         self.worker_id = None           # assigned at register
@@ -82,6 +95,23 @@ class FleetWorker:
         self._server = None
         self._lease_ttl_s = None
         self._floor_cache = {}   # fname -> minimum-footprint estimate
+        #: distributed tracing (ISSUE 14): ``trace=True`` gives this
+        #: worker its OWN tracer (a contextvar override, so N
+        #: in-process workers trace under their own identities); unit
+        #: spans bind the lease's trace_id and drain to the
+        #: coordinator in every ``complete`` message
+        self.trace = bool(trace)
+        self.tracer = None
+        self._trace_mark = 0
+        self._trace_seq = 0     # monotonic per-completion payload id
+        #: measured wall-clock offset vs the coordinator (midpoint
+        #: rule, refreshed at register); 0.0 until measured
+        self.clock_offset_s = 0.0
+        #: metric time-series (ISSUE 14): a sampling interval arms the
+        #: ring-buffer sampler and the /metrics/history endpoint the
+        #: coordinator's sweep scrapes
+        self.history_interval_s = history_interval_s
+        self.sampler = None
 
     # -- drain ----------------------------------------------------------------
 
@@ -98,26 +128,51 @@ class FleetWorker:
 
     # -- protocol client ------------------------------------------------------
 
-    def _post(self, path, doc, timeout=30.0):
+    def _post(self, path, doc, timeout=30.0, timing=None):
         # bounded retry + backoff/jitter on transient transport
         # failures (ISSUE 12 satellite): one flaky connect no longer
-        # fails the register/lease/complete/release call outright
+        # fails the register/lease/complete/release call outright.
+        # ``timing`` brackets the successful attempt only — the
+        # clock-offset midpoint rule must never see retry backoff.
         return protocol.post_json_retry(self.coordinator_url + path, doc,
-                                        timeout=timeout)
+                                        timeout=timeout, timing=timing)
+
+    def _update_clock_offset(self, timing, doc):
+        """Refresh the measured coordinator clock offset from one timed
+        exchange (register or lease — the offset tracks drift over a
+        long-lived worker's life, per the midpoint rule).  No
+        ``server_time`` (old coordinator) or no timing = keep the last
+        estimate."""
+        server_time = doc.get("server_time")
+        if server_time is None or "t0" not in timing:
+            return
+        self.clock_offset_s = clock_offset(timing["t0"], timing["t1"],
+                                           server_time)
+        if self.worker_id is not None:
+            _metrics.gauge("putpu_trace_clock_offset_seconds",
+                           worker=self.worker_id).set(
+                round(self.clock_offset_s, 6))
 
     def _register(self, retries=40, backoff_s=0.25):
         healthz_url = None
         if self.http_port is not None:
             if self._server is None:   # re-registration keeps the port
+                if self.sampler is None \
+                        and self.history_interval_s is not None:
+                    from ..obs.timeseries import TimeSeriesSampler
+
+                    self.sampler = TimeSeriesSampler(
+                        interval_s=self.history_interval_s).start()
                 self._server = start_obs_server(
                     self.http_port, health=self.engine,
                     progress_fn=self._progress_snapshot,
-                    host=self.http_host)
+                    host=self.http_host, timeseries=self.sampler)
             healthz_url = (f"http://{self.http_host}:"
                            f"{self._server.port}/healthz")
         from ..resilience.memory_budget import device_budget_bytes
 
         last = None
+        timing = {}
         for attempt in range(retries):
             try:
                 doc = self._post("/fleet/register",
@@ -127,7 +182,8 @@ class FleetWorker:
                                   # leases to this budget (absent =
                                   # allocator reports no limit)
                                   "mem_budget_bytes":
-                                      device_budget_bytes()})
+                                      device_budget_bytes()},
+                                 timing=timing)
                 break
             except OSError as exc:     # coordinator not up yet
                 last = exc
@@ -145,9 +201,18 @@ class FleetWorker:
         self._lease_ttl_s = float(doc.get("lease_ttl_s") or 30.0)
         if self.poll_s is None:
             self.poll_s = float(doc.get("poll_s") or 0.25)
-        logger.info("fleet worker %s registered with %s (healthz: %s)",
+        # clock sync (ISSUE 14), after worker_id is known so the gauge
+        # gets its label: midpoint rule over the successful exchange
+        # only (timing excludes retry backoff) — the offset the trace
+        # collector applies, recorded as a span attribute so the
+        # correction is auditable.  Absent on an old coordinator:
+        # spans merge uncorrected.  Refreshed on every lease response
+        # too, so a long-lived worker's drift never goes stale.
+        self._update_clock_offset(timing, doc)
+        logger.info("fleet worker %s registered with %s (healthz: %s, "
+                    "clock offset %+.4fs)",
                     self.worker_id, self.coordinator_url,
-                    healthz_url or "disabled")
+                    healthz_url or "disabled", self.clock_offset_s)
 
     def _progress_snapshot(self):
         return {"worker": self.worker_id, "units_done": self.units_done,
@@ -223,6 +288,35 @@ class FleetWorker:
         config = dict(lease["config"])
         config.update(self.search_overrides)
         workload = config.pop("workload", "single_pulse")
+        # bind the lease's distributed-trace context (ISSUE 14): every
+        # span the driver records on this thread — chunk, dispatch,
+        # persist — carries the unit's trace_id, so the coordinator's
+        # lease span and this worker's work share one causal timeline.
+        # A malformed/forward-incompatible context must degrade to an
+        # UNTRACED unit, never crash the worker mid-lease — tracing is
+        # observability, and the protocol promises absent-field
+        # back-compat in both directions.
+        try:
+            tctx = protocol.clean_trace_context(lease.get("trace"))
+        except ValueError as exc:
+            logger.warning(
+                "fleet worker %s: lease %s trace context rejected "
+                "(%r) — running the unit untraced (coordinator newer "
+                "than this worker?)", self.worker_id, lease["lease"],
+                exc)
+            tctx = None
+        ctx = (_trace.trace_context(tctx["trace_id"],
+                                    tctx.get("parent_span_id"))
+               if tctx else contextlib.nullcontext())
+        with ctx, _trace.span("unit", unit=lease["unit"],
+                              lease=lease["lease"],
+                              worker=self.worker_id,
+                              chunks=len(lease["chunks"])):
+            return self._run_unit_inner(lease, config, workload)
+
+    def _run_unit_inner(self, lease, config, workload):
+        from ..pipeline.search_pipeline import search_by_chunks
+
         # deterministic wedge/crash seam for the chaos drill: an armed
         # FaultPlan (PUTPU_FAULT_PLAN survives the subprocess boundary)
         # can hang or fail this worker at unit granularity
@@ -263,7 +357,7 @@ class FleetWorker:
             return repr(exc)
 
     def _complete(self, lease, error):
-        return self._post("/fleet/complete", {
+        doc = {
             "worker": self.worker_id, "lease": lease["lease"],
             "unit": lease["unit"], "error": error,
             # a drain-truncated unit says so: the coordinator requeues
@@ -272,7 +366,31 @@ class FleetWorker:
             "drained": self._drain.is_set(),
             "metrics": _metrics.REGISTRY.snapshot(),
             "health": {"status": self.engine.verdict,
-                       "reasons": self.engine.reasons()}})
+                       "reasons": self.engine.reasons()}}
+        new_mark = None
+        if self.tracer is not None:
+            # incremental span drain (ISSUE 14): only events since the
+            # previous completion ride this message; the full list
+            # stays local for an end-of-run export (--trace-out).
+            # ``seq`` makes the payload idempotent on the coordinator:
+            # a wire-level resend of this same message (lost response,
+            # post_json_retry) must not double every span in the
+            # merged trace.
+            events, new_mark = self.tracer.events_since(self._trace_mark)
+            doc["trace"] = {"events": events,
+                            "tracks": self.tracer.tracks(),
+                            "epoch_unix": self.tracer.epoch_unix,
+                            "clock_offset_s": self.clock_offset_s,
+                            "seq": self._trace_seq + 1}
+        resp = self._post("/fleet/complete", doc)
+        if new_mark is not None:
+            # commit the drain cursor only AFTER the post landed: a
+            # completion that failed past its retries must leave the
+            # events in place for the NEXT message, or the merged
+            # trace permanently loses this unit's worker spans
+            self._trace_mark = new_mark
+            self._trace_seq += 1
+        return resp
 
     def _release(self, leases, reason):
         if not leases:
@@ -297,6 +415,14 @@ class FleetWorker:
         ``None`` polls forever (the deployment shape: workers outlive
         surveys).  Returns the number of units this worker completed.
         """
+        tracer_token = None
+        if self.trace and self.tracer is None:
+            # the worker's OWN tracer, installed as a contextvar
+            # override on this thread: driver spans recorded while a
+            # unit runs land here — not on any process-wide tracer —
+            # so N in-process workers each drain their own identity
+            self.tracer = _trace.Tracer()
+            tracer_token = _trace.push_tracer(self.tracer)
         self._register()
         idle_since = None
         try:
@@ -306,13 +432,19 @@ class FleetWorker:
                     # a denied worker whose transient conditions decayed
                     # must be able to TELL the coordinator so (probes
                     # only exist where a healthz_url was registered)
+                    timing = {}
                     resp = self._post("/fleet/lease",
                                       {"worker": self.worker_id,
                                        "max_units": self.max_units,
                                        "health": {
                                            "status": self.engine.verdict,
                                            "reasons":
-                                               self.engine.reasons()}})
+                                               self.engine.reasons()}},
+                                      timing=timing)
+                    # every lease poll refreshes the clock offset: a
+                    # worker that outlives surveys must track drift,
+                    # not trust its registration-time estimate forever
+                    self._update_clock_offset(timing, resp)
                 except (OSError, ValueError) as exc:
                     if "unknown worker" in str(exc):
                         # the coordinator restarted and lost its worker
@@ -414,6 +546,10 @@ class FleetWorker:
                     "in-flight chunk finished, ledger flushed, "
                     "unstarted leases returned)",
                     self.worker_id or "<unregistered>", self.units_done)
+            if tracer_token is not None:
+                _trace.pop_tracer(tracer_token)
+            if self.sampler is not None:
+                self.sampler.stop()
             if self._server is not None:
                 self._server.close()
         return self.units_done

@@ -30,6 +30,15 @@ The **live surface** (ISSUE 5) builds on those pillars:
 * :mod:`.report` — the end-of-run self-contained survey report
   (markdown + single-file HTML).
 
+The **distributed layer** (ISSUE 14) extends them across processes:
+
+* :mod:`.timeseries` — a bounded ring-buffer sampler over the registry
+  (counters→rates, histograms→p50/p95/p99) behind ``/metrics/history``;
+* :mod:`.slo` — declarative SLOs with multi-window burn-rate alerting
+  (``/alerts``, ``ALERTS_JSON``, HealthEngine conditions);
+* :mod:`.collector` — coordinator + N workers stitched into ONE
+  clock-skew-corrected Perfetto trace (trace ids ride the fleet wire).
+
 Everything here is dependency-light (stdlib + lazy jax) and safe to
 import before a JAX backend exists.
 """
@@ -37,22 +46,30 @@ import before a JAX backend exists.
 from . import gate, memory, metrics, roofline, trace
 from .metrics import REGISTRY
 from .trace import (begin_span, is_tracing, set_track, span, start_tracing,
-                    stop_tracing, trace_session)
+                    stop_tracing, trace_context, trace_session)
 # the live surface imports utils.logging_utils (which imports .metrics /
 # .trace) — keep these AFTER the pillar imports above so the partially
 # initialised package already exposes what the cycle re-enters for
-from . import canary, health, report, server
+from . import canary, collector, health, report, server, slo, timeseries
 from .canary import CanaryController
+from .collector import TraceCollector
 from .health import HealthEngine
 from .server import ObsServer, start_obs_server
+from .slo import SLOEngine, SLOSpec
+from .timeseries import TimeSeriesSampler
 
 __all__ = [
     "CanaryController",
     "HealthEngine",
     "ObsServer",
     "REGISTRY",
+    "SLOEngine",
+    "SLOSpec",
+    "TimeSeriesSampler",
+    "TraceCollector",
     "begin_span",
     "canary",
+    "collector",
     "gate",
     "health",
     "is_tracing",
@@ -62,10 +79,13 @@ __all__ = [
     "roofline",
     "server",
     "set_track",
+    "slo",
     "span",
     "start_obs_server",
     "start_tracing",
     "stop_tracing",
+    "timeseries",
     "trace",
+    "trace_context",
     "trace_session",
 ]

@@ -34,7 +34,9 @@ __all__ = ["DEFAULT_REL_TOL", "SCHEMA_VERSION", "load_snapshot",
 #: changes.  The gate REJECTS a snapshot with a missing or mismatched
 #: version instead of silently comparing incompatible records — a
 #: schema drift must fail loudly, not pass as a 100%-ratio no-op.
-SCHEMA_VERSION = 1
+#: v2 (ISSUE 14): BUDGET_JSON grew the ``chunk_wall_s`` p50/p95/p99
+#: block, and the suite grew config 18 — regenerate baselines.
+SCHEMA_VERSION = 2
 
 #: default relative tolerance — CPU wall-clock on shared runners jitters
 #: by tens of percent; the gate targets step regressions (2x+), so a

@@ -298,6 +298,50 @@ class HealthEngine:
             self._refold(chunk)
             return self._verdict
 
+    # -- external conditions (the SLO engine's seam, ISSUE 14) ---------------
+
+    def note_alert(self, kind, severity, detail, chunk="slo"):
+        """Raise (or refresh) a condition from OUTSIDE the per-chunk
+        update path — the SLO engine feeds burn-rate alerts here, so a
+        budget burn degrades the same verdict the fleet's lease gating
+        and ``/healthz`` probes already act on.  Unlike chunk-raised
+        conditions the severity tracks the raiser EXACTLY — a page
+        that subsides to a ticket must de-escalate ``/healthz`` from
+        503, not hold CRITICAL until the slow window drains.
+        Externally-raised conditions do not decay on chunk updates
+        (the raiser knows when the burn stopped): pair with
+        :meth:`resolve_alert`."""
+        with self._lock:
+            cond = self._active.get(kind)
+            if cond is None or _RANK[severity] > _RANK[cond.severity]:
+                self._incidents.append({
+                    "chunk": chunk, "kind": kind, "severity": severity,
+                    "event": "raised", "detail": detail,
+                    "t": round(time.time(), 3)})
+                _metrics.counter("putpu_health_incidents_total",
+                                 kind=kind).inc()
+            if cond is None:
+                self._active[kind] = _Condition(
+                    kind, severity, detail, self.recover_after,
+                    sticky=True)
+            else:
+                cond.severity = severity      # both directions
+                cond.detail = detail
+                cond.ttl = self.recover_after
+            self._refold(chunk)
+
+    def resolve_alert(self, kind, chunk="slo"):
+        """Clear a :meth:`note_alert` condition once its source stops
+        firing (idempotent)."""
+        with self._lock:
+            cond = self._active.pop(kind, None)
+            if cond is not None:
+                self._incidents.append({
+                    "chunk": chunk, "kind": kind,
+                    "severity": cond.severity, "event": "resolved",
+                    "detail": cond.detail, "t": round(time.time(), 3)})
+            self._refold(chunk)
+
     # -- read side -----------------------------------------------------------
 
     @property

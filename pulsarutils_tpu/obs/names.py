@@ -93,6 +93,9 @@ METRIC_NAMES = {
         "coincidence group verdicts (labelled rfi/confirmed/ambiguous)",
     "putpu_coincidence_vetoed_candidates_total":
         "per-beam candidates absorbed by anti-coincidence RFI vetoes",
+    "putpu_chunk_wall_seconds":
+        "histogram of per-chunk wall seconds (the chunk-wall p95 SLO's "
+        "source; BUDGET_JSON quotes exact percentiles from the ledger)",
     "putpu_chunks_quarantined_total":
         "chunks quarantined by the integrity gate",
     "putpu_chunks_sanitized_total":
@@ -156,6 +159,8 @@ METRIC_NAMES = {
         "service jobs reaching a terminal state (labelled by status)",
     "putpu_jobs_submitted_total":
         "jobs accepted by the survey service",
+    "putpu_metric_history_samples_total":
+        "time-series ring-buffer samples taken over the registry",
     "putpu_lowbit_bytes_saved_total":
         "link bytes the packed low-bit upload saved vs float32",
     "putpu_lowbit_packed_chunks_total":
@@ -235,12 +240,24 @@ METRIC_NAMES = {
         "sift rejections (labelled by reason)",
     "putpu_sift_snr":
         "histogram of kept-candidate S/N",
+    "putpu_slo_alerts_total":
+        "burn-rate alerts newly fired (labelled by slo and severity)",
+    "putpu_slo_budget_remaining":
+        "fraction of the SLO error budget left over the budget window "
+        "(labelled by slo)",
+    "putpu_slo_evaluations_total":
+        "SLO engine evaluation passes over the metric time-series",
     "putpu_stream_chunks_failed_total":
         "stream chunks dropped under skip_failed containment",
     "putpu_stream_chunks_total":
         "chunks completed by stream_search",
     "putpu_stream_hits_total":
         "stream chunks whose best S/N cleared the threshold",
+    "putpu_trace_clock_offset_seconds":
+        "worker wall clock offset vs the coordinator, midpoint rule "
+        "over the register/lease exchange (labelled by worker)",
+    "putpu_trace_spans_collected_total":
+        "worker span events stitched into the fleet trace collector",
 }
 
 #: per-chunk budget counters mirrored dynamically by

@@ -45,7 +45,8 @@ def _fmt(v, nd=3):
 
 def build_report(*, meta=None, budget=None, roofline=None, health=None,
                  canary=None, quarantine=None, sift=None, metrics=None,
-                 coincidence=None, fleet=None, periodicity=None):
+                 coincidence=None, fleet=None, periodicity=None,
+                 slo=None):
     """Assemble the structured report record (JSON-ready).
 
     ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
@@ -57,9 +58,12 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
     out for the header); ``coincidence``: ``{"stats": COINCIDENCE_JSON
     dict, "groups": beams.coincidence.group_summary(...) rows}`` from
     the multi-beam driver; ``fleet``:
-    ``FleetCoordinator.summary()`` from a coordinator run (ISSUE 9);
-    ``periodicity``: the periodicity driver's ``PERIOD_JSON`` summary
-    plus its folded candidate rows (ISSUE 13).
+    ``FleetCoordinator.summary()`` from a coordinator run (ISSUE 9 —
+    with per-worker metric ``history`` trends when the sweep scraped
+    any, ISSUE 14); ``periodicity``: the periodicity driver's
+    ``PERIOD_JSON`` summary plus its folded candidate rows (ISSUE 13);
+    ``slo``: ``SLOEngine.to_json()`` — the "SLOs & alerts" section
+    (ISSUE 14).
     """
     rec = {
         "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -73,6 +77,7 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
         "coincidence": coincidence,
         "fleet": fleet,
         "periodicity": periodicity,
+        "slo": slo,
     }
     if metrics:
         totals = {}
@@ -147,6 +152,39 @@ def render_markdown(rec):
     else:
         lines += ["No health engine was wired into this run.", ""]
 
+    lines.append("## SLOs & alerts")
+    lines.append("")
+    slo = rec.get("slo")
+    if slo:
+        active = slo.get("active_alerts") or []
+        lines.append(
+            f"{slo.get('evaluations', 0)} burn-rate evaluation(s), "
+            f"{slo.get('alerts_fired_total', 0)} alert(s) fired, "
+            f"**{len(active)} active at end of run**.")
+        lines.append("")
+        if active:
+            lines.append(_md_table(
+                ("slo", "severity", "burn fast/slow", "windows (s)",
+                 "budget remaining"),
+                [(a["slo"], a["severity"],
+                  f"{_fmt(a['burn_fast'], 1)}x / {_fmt(a['burn_slow'], 1)}x",
+                  "/".join(str(int(w)) for w in a["window_s"]),
+                  "-" if a.get("budget_remaining") is None
+                  else f"{100 * a['budget_remaining']:.0f}%")
+                 for a in active]))
+            lines.append("")
+        rows = [(r.get("slo"), _fmt(r.get("objective")),
+                 "-" if r.get("budget_remaining") is None
+                 else f"{100 * r['budget_remaining']:.0f}%")
+                for r in (slo.get("slos") or [])]
+        if rows:
+            lines.append(_md_table(
+                ("slo", "objective", "budget remaining"), rows))
+            lines.append("")
+    else:
+        lines += ["No SLO engine was armed for this run (burn-rate "
+                  "alerting off).", ""]
+
     lines.append("## Canary injection-recovery")
     lines.append("")
     canary = rec.get("canary")
@@ -192,6 +230,14 @@ def render_markdown(rec):
             f"chunk wall, {_fmt(budget.get('attributed_pct'), 1)}% "
             "attributed.")
         lines.append("")
+        cw = budget.get("chunk_wall_s")
+        if cw:
+            lines.append(
+                f"Chunk wall p50/p95/p99: **{_fmt(cw.get('p50'))}s / "
+                f"{_fmt(cw.get('p95'))}s / {_fmt(cw.get('p99'))}s** "
+                "(the tail, not just the mean — the chunk-wall SLO's "
+                "indicator).")
+            lines.append("")
         rows = [(k, _fmt(v), f"{100.0 * v / wall:.1f}%" if wall else "-")
                 for k, v in (budget.get("buckets_s") or {}).items()]
         rows.append(("unattributed", _fmt(budget.get("unattributed_s")),
@@ -306,6 +352,23 @@ def render_markdown(rec):
                 ("worker", "verdict", "alive", "units completed"),
                 [(w["worker"], w["verdict"], w["alive"],
                   w["units_completed"]) for w in fleet["workers"]]))
+        history = fleet.get("history")
+        if history:
+            lines.append("")
+            lines.append("Per-worker metric trends (scraped from each "
+                         "worker's `/metrics/history` on the sweep — "
+                         "first → last over the scraped window):")
+            lines.append("")
+            rows = []
+            for worker, series in sorted(history.items()):
+                for name, pts in sorted(series.items()):
+                    vals = [p[1] for p in pts]
+                    rows.append((worker, name, len(pts),
+                                 _fmt(vals[0]), _fmt(vals[-1]),
+                                 _fmt(min(vals)), _fmt(max(vals))))
+            lines.append(_md_table(
+                ("worker", "series", "points", "first", "last", "min",
+                 "max"), rows))
     else:
         lines.append("Single-process run: no fleet coordinator was "
                      "involved.")

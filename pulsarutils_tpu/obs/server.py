@@ -67,10 +67,15 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server API
         srv = self.server.obs  # type: ignore[attr-defined]
         try:
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            path, _, query = self.path.partition("?")
+            path = path.rstrip("/") or "/"
             if path == "/metrics":
                 self._send(200, srv.registry.prometheus_text(),
                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/metrics/history":
+                self._get_history(srv, query)
+            elif path == "/alerts":
+                self._get_alerts(srv)
             elif path == "/healthz":
                 doc = srv.health_snapshot()
                 status = 503 if doc["status"] == "CRITICAL" else 200
@@ -85,7 +90,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._get_fleet(srv, path)
             elif path == "/":
                 self._send(200, "pulsarutils_tpu live survey surface: "
-                           "/metrics /healthz /progress /jobs /fleet\n",
+                           "/metrics /metrics/history /alerts /healthz "
+                           "/progress /jobs /fleet\n",
                            "text/plain")
             else:
                 self._send(404, "not found\n", "text/plain")
@@ -94,6 +100,32 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(500, f"internal error: {exc!r}\n", "text/plain")
             except Exception:
                 pass
+
+    def _get_history(self, srv, query):
+        """GET /metrics/history[?last=N]: the bounded time-series ring
+        (ISSUE 14) — the endpoint the fleet coordinator's sweep loop
+        scrapes per worker."""
+        if srv.timeseries is None:
+            self._send(404, "no time-series sampler wired (start the "
+                       "server with timeseries=TimeSeriesSampler(...))\n",
+                       "text/plain")
+            return
+        last = None
+        for part in query.split("&"):
+            key, _, value = part.partition("=")
+            if key == "last" and value.isdigit():
+                last = int(value)
+        self._send(200, json.dumps(srv.timeseries.history_doc(last=last)),
+                   "application/json")
+
+    def _get_alerts(self, srv):
+        """GET /alerts: active burn-rate alerts + per-SLO status."""
+        if srv.slo is None:
+            self._send(404, "no SLO engine wired (start the server with "
+                       "slo=SLOEngine(...))\n", "text/plain")
+            return
+        self._send(200, json.dumps(srv.slo.alerts_doc(), indent=1),
+                   "application/json")
 
     def _get_jobs(self, srv, path):
         """GET /jobs (list) and /jobs/<id> (one document)."""
@@ -129,7 +161,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         docs = {"/fleet/workers": srv.fleet.workers_doc,
                 "/fleet/leases": srv.fleet.leases_doc,
-                "/fleet/progress": srv.fleet.progress_doc}
+                "/fleet/progress": srv.fleet.progress_doc,
+                "/fleet/history": srv.fleet.fleet_history_doc}
         fn = docs.get(path)
         if fn is None:
             self._send(404, "not found\n", "text/plain")
@@ -219,9 +252,15 @@ class ObsServer:
 
     def __init__(self, port=0, health=None, progress_fn=None,
                  registry=None, host="127.0.0.1", service=None,
-                 fleet=None):
+                 fleet=None, timeseries=None, slo=None):
         self.health = health
         self.progress_fn = progress_fn
+        #: a :class:`~.timeseries.TimeSeriesSampler` (or None): wired,
+        #: GET /metrics/history serves the ring-buffer history
+        self.timeseries = timeseries
+        #: a :class:`~.slo.SLOEngine` (or None): wired, GET /alerts
+        #: serves the active burn-rate alerts + per-SLO status
+        self.slo = slo
         #: a :class:`~pulsarutils_tpu.beams.service.SurveyService` (or
         #: None): wired, the surface grows the job-submission API —
         #: POST /jobs, GET /jobs[/<id>], POST /jobs/<id>/cancel
@@ -278,7 +317,8 @@ class ObsServer:
 
 
 def start_obs_server(port, health=None, progress_fn=None, registry=None,
-                     host="127.0.0.1", service=None, fleet=None):
+                     host="127.0.0.1", service=None, fleet=None,
+                     timeseries=None, slo=None):
     """Start the live surface; returns the :class:`ObsServer` handle
     (``handle.port`` holds the bound port — pass ``port=0`` for an
     ephemeral one).  ``host`` is the bind address: the loopback default
@@ -290,7 +330,12 @@ def start_obs_server(port, health=None, progress_fn=None, registry=None,
     :class:`~pulsarutils_tpu.fleet.coordinator.FleetCoordinator`)
     serves the fleet wire protocol + read endpoints under ``/fleet/``
     — the coordinator role is this same ThreadingHTTPServer machinery,
-    not a second stack."""
+    not a second stack.  ``timeseries`` (a
+    :class:`~pulsarutils_tpu.obs.timeseries.TimeSeriesSampler`) serves
+    ``GET /metrics/history``; ``slo`` (a
+    :class:`~pulsarutils_tpu.obs.slo.SLOEngine`) serves ``GET
+    /alerts`` (ISSUE 14) — both read-only views over telemetry the
+    wired objects already hold."""
     return ObsServer(port=port, health=health, progress_fn=progress_fn,
                      registry=registry, host=host, service=service,
-                     fleet=fleet)
+                     fleet=fleet, timeseries=timeseries, slo=slo)

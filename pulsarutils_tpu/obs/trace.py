@@ -19,6 +19,18 @@ Synchronous nesting is the common case (:func:`span`); device work that
 thread and out of stack order — exactly how an async device dispatch
 relates to its block-until-ready readback.
 
+Distributed tracing (ISSUE 14): a **trace context** — a ``trace_id``
+plus the parent span id that caused this work — binds via
+:func:`trace_context` and is stamped onto every span recorded while
+bound, so one fleet lease's spans on the coordinator and on the worker
+that ran it share one ``trace_id`` across the process boundary (the
+ids ride the fleet wire; :mod:`.collector` stitches the per-process
+traces into one clock-aligned Perfetto file).  In-process fleet
+workers each get their OWN tracer via :func:`push_tracer` (a
+contextvar override of the process-wide default), so a worker's spans
+drain over the wire under its identity even when coordinator and
+workers share one process.
+
 The module is stdlib-only and never imports jax; :func:`trace_session`
 drives ``jax.profiler`` lazily so one flag can emit both the span JSON
 and the XLA device trace into the same run directory.
@@ -33,19 +45,72 @@ import json
 import logging
 import threading
 import time
+import uuid
 
 logger = logging.getLogger("pulsarutils_tpu")
 
 #: the process-wide active tracer (None = tracing off).  A bare module
-#: global on purpose: reads must be one LOAD_GLOBAL in hot paths, and
+#: global on purpose: reads must stay cheap in hot paths, and
 #: start/stop happen at run granularity, not per span.
 _TRACER = None
+
+#: per-context tracer OVERRIDE (ISSUE 14): an in-process fleet worker
+#: pushes its own :class:`Tracer` here so its spans — including every
+#: driver span recorded on the worker's thread — land on the worker's
+#: tracer, not the process default.  Threads the worker spawns do not
+#: inherit the contextvar, but the spans that matter there are
+#: :class:`AsyncSpan` handles whose tracer was captured at ``begin``.
+_TRACER_VAR = contextvars.ContextVar("putpu_tracer", default=None)
+
+#: the bound distributed-trace context: ``{"trace_id": str,
+#: "parent_span_id": str|None}`` or None.  Read once per recorded span.
+_TRACE_CTX = contextvars.ContextVar("putpu_trace_ctx", default=None)
 
 #: logical track for spans on this (logical) thread of control — set per
 #: chunk by the budget accountant so each chunk renders as its own
 #: Perfetto track.  ContextVar, not thread-local: worker threads started
 #: per chunk inherit the chunk's context.
 _TRACK = contextvars.ContextVar("putpu_trace_track", default=None)
+
+
+def new_trace_id():
+    """A fresh 16-hex-char distributed trace id (no central allocator:
+    collision odds over a survey's unit count are negligible)."""
+    return uuid.uuid4().hex[:16]
+
+
+@contextlib.contextmanager
+def trace_context(trace_id, parent_span_id=None):
+    """Bind a distributed-trace context: every span recorded in this
+    context carries ``trace_id`` (and ``parent_span_id`` when given) in
+    its args, so cross-process consumers can stitch one causal timeline
+    per job/lease.  Free when no tracer is active; nestable (the inner
+    binding wins)."""
+    ctx = {"trace_id": str(trace_id)}
+    if parent_span_id is not None:
+        ctx["parent_span_id"] = str(parent_span_id)
+    token = _TRACE_CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _TRACE_CTX.reset(token)
+
+
+def current_trace_context():
+    """The bound trace context dict, or ``None``."""
+    return _TRACE_CTX.get()
+
+
+def push_tracer(tracer):
+    """Install ``tracer`` as this context's tracer (overrides the
+    process-wide one set by :func:`start_tracing`).  Pair with
+    :func:`pop_tracer`.  The fleet worker's seam: N in-process workers
+    each trace under their own identity."""
+    return _TRACER_VAR.set(tracer)
+
+
+def pop_tracer(token):
+    _TRACER_VAR.reset(token)
 
 
 class Span:
@@ -71,7 +136,7 @@ def close_span(s, track=None):
     there, so there is exactly one measurement per interval."""
     s.t1 = time.perf_counter()
     s.dur = s.t1 - s.t0
-    tr = _TRACER
+    tr = _TRACER_VAR.get() or _TRACER
     if tr is not None:
         tr.complete(s, track)
     return s
@@ -131,7 +196,7 @@ class AsyncSpan:
 def begin_span(name, track=None, **attrs):
     """Open an async span on the active tracer; no-op handle when
     tracing is off (callers hold the handle and ``end()`` it blindly)."""
-    tr = _TRACER
+    tr = _TRACER_VAR.get() or _TRACER
     if tr is None:
         return _NULL_ASYNC
     return AsyncSpan(name, attrs or None, track or _TRACK.get(), tr)
@@ -171,7 +236,13 @@ class Tracer:
         self._tracks = {}       # track name -> tid (1-based, stable order)
         self._seq = itertools.count(1)
         self._closed = False
+        # both clocks anchored back-to-back: ``epoch`` is the event
+        # timescale (perf_counter, monotonic), ``epoch_unix`` is the
+        # same instant on the wall clock — the anchor the distributed
+        # collector uses to place this process's events on a shared,
+        # skew-corrected timeline (ISSUE 14)
         self.epoch = time.perf_counter()
+        self.epoch_unix = time.time()
 
     def next_id(self):
         return next(self._seq)
@@ -198,6 +269,16 @@ class Tracer:
     def _ts(self, t):
         return round((t - self.epoch) * 1e6, 3)  # perf_counter s -> us
 
+    @staticmethod
+    def _stamp_ctx(ev):
+        """Merge the bound distributed-trace context into ``ev`` args —
+        read at record time on the recording thread, so a worker's unit
+        spans carry the lease's ``trace_id`` across the wire."""
+        ctx = _TRACE_CTX.get()
+        if ctx is not None:
+            ev["args"] = {**ev.get("args", {}), **ctx}
+        return ev
+
     def complete(self, s, track=None):
         ev = {"name": s.name, "ph": "X", "pid": 1,
               "tid": self._tid(track if track is not None
@@ -205,14 +286,14 @@ class Tracer:
               "ts": self._ts(s.t0), "dur": round(s.dur * 1e6, 3)}
         if s.attrs:
             ev["args"] = {k: _jsonable(v) for k, v in s.attrs.items()}
-        self._append(ev)
+        self._append(self._stamp_ctx(ev))
 
     def async_begin(self, a):
         ev = {"name": a.name, "ph": "b", "cat": "async", "id": a._id,
               "pid": 1, "tid": self._tid(a.track), "ts": self._ts(a.t0)}
         if a.attrs:
             ev["args"] = {k: _jsonable(v) for k, v in a.attrs.items()}
-        self._append(ev)
+        self._append(self._stamp_ctx(ev))
 
     def async_end(self, a, t1, attrs=None):
         ev = {"name": a.name, "ph": "e", "cat": "async", "id": a._id,
@@ -227,8 +308,27 @@ class Tracer:
 
     # -- export --------------------------------------------------------------
 
+    def events_since(self, mark=0):
+        """``(events, new_mark)`` — the span events recorded at index
+        ``mark`` onward plus the cursor for the next call.  The fleet
+        worker's incremental drain: each ``complete`` message ships only
+        the events since the previous one, while the full list stays in
+        place for an end-of-run :meth:`export`."""
+        with self._lock:
+            return list(self._events[mark:]), len(self._events)
+
+    def tracks(self):
+        """``{track name: tid}`` snapshot (ships beside drained events
+        so the collector can name the worker's rows)."""
+        with self._lock:
+            return dict(self._tracks)
+
     def to_chrome(self):
-        """The Chrome trace-event dict (metadata + recorded events)."""
+        """The Chrome trace-event dict (metadata + recorded events).
+        The extra top-level ``putpu`` key (Perfetto ignores unknown
+        keys) carries the wall-clock anchor :mod:`.collector` and
+        ``tools/trace_merge.py`` need for post-hoc cross-process
+        stitching."""
         with self._lock:
             events = list(self._events)
             tracks = dict(self._tracks)
@@ -239,11 +339,18 @@ class Tracer:
                          "tid": tid, "args": {"name": track}})
             meta.append({"name": "thread_sort_index", "ph": "M", "pid": 1,
                          "tid": tid, "args": {"sort_index": tid}})
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "putpu": {"epoch_unix": self.epoch_unix}}
 
-    def export(self, path):
-        """Write the trace JSON; returns the number of span events."""
+    def export(self, path, extra_meta=None):
+        """Write the trace JSON; returns the number of span events.
+        ``extra_meta`` merges into the ``putpu`` stitching envelope —
+        the fleet worker records its measured ``clock_offset_s`` there
+        so an offline ``tools/trace_merge.py`` corrects skew exactly as
+        the live collector would."""
         doc = self.to_chrome()
+        if extra_meta:
+            doc["putpu"].update(extra_meta)
         with open(path, "w") as f:
             json.dump(doc, f)
         n = sum(ev.get("ph") in ("X", "b") for ev in doc["traceEvents"])
@@ -273,11 +380,13 @@ def stop_tracing():
 
 
 def active_tracer():
-    return _TRACER
+    """This context's tracer: the :func:`push_tracer` override when one
+    is bound, else the process-wide tracer."""
+    return _TRACER_VAR.get() or _TRACER
 
 
 def is_tracing():
-    return _TRACER is not None
+    return (_TRACER_VAR.get() or _TRACER) is not None
 
 
 @contextlib.contextmanager

@@ -1484,6 +1484,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # like the serial loop (code-review r6)
             payload = (info, sci_table) if is_hit else None
             if persist_pool is not None:
+                # putpu-lint: disable=span-leak — ends in _persist_async on the FIFO persist worker (cross-thread by design; the drain barrier guarantees completion)
                 pspan = begin_span("persist", track="persist-worker",
                                    chunk=istart)
                 persist_futures.append(persist_pool.submit(

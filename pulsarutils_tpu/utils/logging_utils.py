@@ -73,6 +73,30 @@ class StageTimer:
 #: never misattribute into the main thread's serial buckets.
 _ACTIVE_BUDGET = contextvars.ContextVar("putpu_budget", default=None)
 
+#: chunk-wall histogram edges: decade-ish coverage from sub-100ms CPU
+#: test chunks to multi-minute tunnelled-TPU chunks
+_CHUNK_WALL_EDGES = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0)
+
+
+def _percentile(sorted_values, q):
+    """Linear-interpolation percentile of an already-sorted list (the
+    numpy default rule, reimplemented so the ledger stays stdlib-only
+    and byte-deterministic)."""
+    n = len(sorted_values)
+    if n == 0:
+        return None
+    if n == 1:
+        return float(sorted_values[0])
+    pos = q * (n - 1)
+    lo = int(pos)
+    frac = pos - lo
+    if lo + 1 >= n:
+        return float(sorted_values[-1])
+    return float(sorted_values[lo] * (1.0 - frac)
+                 + sorted_values[lo + 1] * frac)
+
+
 #: process-wide XLA compile observation (jax.monitoring events); installed
 #: lazily, once — the listener registry has no deregister, so the counts
 #: are cumulative and consumers take deltas
@@ -257,6 +281,12 @@ class BudgetAccountant(StageTimer):
             top = sum(v for k, v in rec["buckets"].items() if "/" not in k)
             rec["unattributed_s"] = round(rec["wall_s"] - top, 4)
             rec["wall_s"] = round(rec["wall_s"], 4)
+            # chunk-wall distribution (ISSUE 14): the SLO engine's
+            # latency indicator — the histogram feeds the time-series
+            # p95, the ledger below quotes exact percentiles
+            _metrics.histogram("putpu_chunk_wall_seconds",
+                               edges=_CHUNK_WALL_EDGES).observe(
+                rec["wall_s"])
             rec["buckets"] = {k: round(v, 4)
                               for k, v in rec["buckets"].items()}
             self.chunks.append(rec)
@@ -331,13 +361,20 @@ class BudgetAccountant(StageTimer):
                 buckets[k] = buckets.get(k, 0.0) + v
         top = sum(v for k, v in buckets.items() if "/" not in k)
         unattributed = wall - top
+        walls = sorted(c["wall_s"] for c in self.chunks)
         out = {
             # versioned footer (ISSUE 5 satellite): parsers and the perf
             # gate key off this instead of silently comparing records
-            # whose meaning drifted
+            # whose meaning drifted.  ISSUE 14 added chunk_wall_s
+            # percentiles — the schema_version bump that versions it.
             "schema_version": SCHEMA_VERSION,
             "chunks": nchunks,
             "wall_s": round(wall, 3),
+            "chunk_wall_s": ({
+                "p50": round(_percentile(walls, 0.50), 4),
+                "p95": round(_percentile(walls, 0.95), 4),
+                "p99": round(_percentile(walls, 0.99), 4)}
+                if walls else None),
             "buckets_s": {k: round(v, 3) for k, v in sorted(
                 buckets.items(), key=lambda kv: -kv[1])},
             "unattributed_s": round(unattributed, 3),
@@ -393,6 +430,10 @@ class BudgetAccountant(StageTimer):
         log.info("chunk budget over %d chunks, %.2fs wall "
                  "(%.1f%% attributed):", j["chunks"], j["wall_s"],
                  j["attributed_pct"] or 0.0)
+        cw = j.get("chunk_wall_s")
+        if cw:
+            log.info("  chunk wall p50/p95/p99: %.3f / %.3f / %.3f s",
+                     cw["p50"], cw["p95"], cw["p99"])
         # group children under their PARENT (a flat sort-by-total can
         # interleave a child below an unrelated small bucket and
         # misrepresent the hierarchy — code-review r6)

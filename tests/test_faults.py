@@ -246,12 +246,14 @@ def test_default_run_is_inert_and_byte_identical(survey_file, tmp_path):
                 if f.startswith("quarantine")]
     assert b"quarantined" not in led_a
     # BUDGET_JSON: same record keys as the round-6/7 ledger (plus the
-    # ISSUE-5 schema_version stamp and the ISSUE-7 autotune decision
+    # ISSUE-5 schema_version stamp, the ISSUE-14 chunk_wall_s
+    # percentile block and the ISSUE-7 autotune decision
     # table — present only when kernel="auto" resolved a geometry key
     # during this stream), and no robustness-named buckets leaked into
     # the default path
     j = acct.to_json()
-    assert set(j) <= {"schema_version", "chunks", "wall_s", "buckets_s",
+    assert set(j) <= {"schema_version", "chunks", "wall_s",
+                      "chunk_wall_s", "buckets_s",
                       "unattributed_s", "attributed_pct", "counters",
                       "async_s", "per_chunk", "per_chunk_truncated",
                       "truncated_chunks", "rtt_s", "trips",

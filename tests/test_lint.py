@@ -291,6 +291,89 @@ def test_lock_discipline_subscript_store_fires():
     assert ids(lint(src, path=OBS)) == ["lock-discipline"]
 
 
+# -- checker: span leaks (ISSUE 14) ------------------------------------------
+
+def test_span_leak_finally_end_is_silent():
+    src = """\
+    from pulsarutils_tpu.obs.trace import begin_span
+    def run():
+        h = begin_span("dispatch")
+        try:
+            work()
+        finally:
+            h.end()
+    """
+    assert lint(src, path=OBS) == []
+
+
+def test_span_leak_straight_line_end_is_silent():
+    src = """\
+    from pulsarutils_tpu.obs.trace import begin_span
+    def run():
+        h = begin_span("dispatch")
+        x = 1
+        h.end()
+    """
+    assert lint(src, path=OBS) == []
+
+
+def test_span_leak_branch_before_end_fires():
+    src = """\
+    from pulsarutils_tpu.obs.trace import begin_span
+    def run(flag):
+        h = begin_span("dispatch")
+        if flag:
+            return None       # h never ends on this path
+        h.end()
+    """
+    assert ids(lint(src, path=OBS)) == ["span-leak"]
+
+
+def test_span_leak_no_end_at_all_fires():
+    src = """\
+    from pulsarutils_tpu.obs.trace import begin_span
+    def run():
+        h = begin_span("dispatch")
+        work(h)
+    """
+    assert ids(lint(src, path=OBS)) == ["span-leak"]
+
+
+def test_span_leak_escaping_handle_fires_and_waives():
+    # attribute store / argument / discard: the function cannot
+    # guarantee the end — findings, waivable at reviewed seams
+    src = """\
+    from pulsarutils_tpu.obs.trace import begin_span
+    def stash(self):
+        self.span = begin_span("lease")
+    def discard():
+        begin_span("oops")
+    """
+    assert ids(lint(src, path=OBS)) == ["span-leak", "span-leak"]
+    waived = """\
+    from pulsarutils_tpu.obs.trace import begin_span
+    def stash(self):
+        # putpu-lint: disable=span-leak — ends at lease resolution
+        self.span = begin_span("lease")
+    """
+    assert lint(waived, path=OBS) == []
+
+
+def test_span_leak_end_inside_try_body_fires():
+    # an end in the try BODY (not finally) is skipped by an exception
+    src = """\
+    from pulsarutils_tpu.obs.trace import begin_span
+    def run():
+        h = begin_span("dispatch")
+        try:
+            work()
+            h.end()
+        except ValueError:
+            pass
+    """
+    assert ids(lint(src, path=OBS)) == ["span-leak"]
+
+
 # -- checker 4: metric/span name drift ---------------------------------------
 
 MANIFEST = {"putpu_known_total"}

@@ -220,11 +220,15 @@ def test_prometheus_conformance_golden():
 
 #: json.dumps(acct.to_json()) captured on the PRE-refactor accountant
 #: with the same fake clock and operation sequence as the test below.
-#: ISSUE 5 added the leading "schema_version" key (a DELIBERATE byte
-#: change, versioned as such) — every other byte is still pinned.
+#: ISSUE 5 added the leading "schema_version" key, ISSUE 14 the
+#: "chunk_wall_s" percentile block (schema_version 1 -> 2) — both
+#: DELIBERATE byte changes, versioned as such; every other byte is
+#: still pinned.
 _GOLDEN_BUDGET_JSON = (
-    '{"schema_version": 1, '
-    '"chunks": 2, "wall_s": 1.125, "buckets_s": {"search": 0.625, '
+    '{"schema_version": 2, '
+    '"chunks": 2, "wall_s": 1.125, '
+    '"chunk_wall_s": {"p50": 0.5625, "p95": 0.5625, "p99": 0.5625}, '
+    '"buckets_s": {"search": 0.625, '
     '"read": 0.125, "search/dispatch": 0.125, "search/readback": 0.125}, '
     '"unattributed_s": 0.375, "attributed_pct": 66.7, '
     '"counters": {"dispatches": 2, "readbacks": 4}, '

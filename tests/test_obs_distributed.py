@@ -1,0 +1,857 @@
+"""Distributed observability tests (ISSUE 14).
+
+Tier-1 pins: trace-context propagation (contextvar binding, per-worker
+tracer isolation), the clock-offset midpoint rule and the collector's
+skew-corrected merge, the trace-context wire round-trip (including
+absent-field back-compat with an old worker), the metric time-series
+sampler (counter rates, histogram percentiles, ring bound, JSONL
+spill), the ``/metrics/history`` + ``/alerts`` endpoints, multi-window
+burn-rate arithmetic over synthetic series, SLO -> HealthEngine
+feed/resolve, the BUDGET_JSON chunk-wall percentile block, the offline
+``tools/trace_merge.py`` stitch, and — load-bearing — on-vs-off byte
+identity of candidates/ledgers through ``search_by_chunks``,
+``multibeam_search`` and a 2-worker fleet run with the whole layer
+armed (the acceptance shape: coordinator and worker spans of one lease
+share a trace_id in ONE merged Perfetto file).
+"""
+
+import glob
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.fleet import protocol
+from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+from pulsarutils_tpu.fleet.worker import FleetWorker
+from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+from pulsarutils_tpu.models.simulate import disperse_array
+from pulsarutils_tpu.obs import metrics, trace
+from pulsarutils_tpu.obs.collector import (TraceCollector, clock_offset,
+                                           merge_trace_files)
+from pulsarutils_tpu.obs.health import HealthEngine
+from pulsarutils_tpu.obs.server import start_obs_server
+from pulsarutils_tpu.obs.slo import SLOEngine, SLOSpec, default_slos
+from pulsarutils_tpu.obs.timeseries import (TimeSeriesSampler,
+                                            histogram_quantile)
+from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+TSAMP = 0.0005
+NCHAN = 64
+NSAMPLES = 24576
+CONFIG = dict(dmmin=100, dmmax=200, chunk_length=8192 * TSAMP,
+              snr_threshold=6.5)
+
+
+def write_file(path, seed=0, pulse=False):
+    rng = np.random.default_rng(seed)
+    arr = np.abs(rng.normal(0, 0.5, (NCHAN, NSAMPLES))) + 20.0
+    if pulse:
+        arr[:, (3 * NSAMPLES) // 4] += 4.0
+        arr = disperse_array(arr, 150.0, 1200., 200., TSAMP)
+    header = {"bandwidth": 200., "fbottom": 1200., "nchans": NCHAN,
+              "nsamples": NSAMPLES, "tsamp": TSAMP,
+              "foff": 200. / NCHAN}
+    write_simulated_filterbank(str(path), arr, header, descending=True)
+    return str(path)
+
+
+def snapshot_dir(outdir):
+    """Ledger bytes + npz members (the fleet comparison rule)."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(str(outdir), "*"))):
+        name = os.path.basename(path)
+        if name.startswith("progress_") and name.endswith(".json"):
+            with open(path, "rb") as f:
+                out[name] = f.read()
+        elif name.endswith(".npz"):
+            with np.load(path, allow_pickle=False) as z:
+                out[name] = {k: (str(z[k].dtype), z[k].shape,
+                                 z[k].tobytes()) for k in z.files}
+    return out
+
+
+def get_json(url):
+    with urllib.request.urlopen(url, timeout=10.0) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def get_status(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return resp.status
+    except urllib.error.HTTPError as exc:
+        return exc.code
+
+
+# ---------------------------------------------------------------------------
+# trace context + per-worker tracer isolation
+# ---------------------------------------------------------------------------
+
+def test_trace_context_stamps_spans_and_clears():
+    tracer = trace.start_tracing()
+    try:
+        with trace.trace_context("cafe01", parent_span_id="9"):
+            with trace.span("inner"):
+                pass
+            h = trace.begin_span("async_op")
+            h.end()
+        with trace.span("outside"):
+            pass
+    finally:
+        trace.stop_tracing()
+    events, _ = tracer.events_since(0)
+    by_name = {e["name"]: e for e in events
+               if e["ph"] in ("X", "b")}     # not the async "e" end
+    assert by_name["inner"]["args"]["trace_id"] == "cafe01"
+    assert by_name["inner"]["args"]["parent_span_id"] == "9"
+    assert by_name["async_op"]["args"]["trace_id"] == "cafe01"
+    # context does not leak past its scope
+    assert "args" not in by_name["outside"] \
+        or "trace_id" not in by_name["outside"].get("args", {})
+
+
+def test_new_trace_id_shape_and_uniqueness():
+    ids = {trace.new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(len(i) == 16 and all(c in "0123456789abcdef" for c in i)
+               for i in ids)
+
+
+def test_push_tracer_isolates_in_process_workers():
+    """Two threads with their own pushed tracers record separately;
+    the process-wide tracer sees neither."""
+    global_tracer = trace.start_tracing()
+    try:
+        tracers = {}
+
+        def work(name):
+            mine = trace.Tracer()
+            tracers[name] = mine
+            token = trace.push_tracer(mine)
+            try:
+                with trace.span(f"unit-{name}"):
+                    pass
+            finally:
+                trace.pop_tracer(token)
+
+        threads = [threading.Thread(target=work, args=(n,))
+                   for n in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        trace.stop_tracing()
+    for name in ("a", "b"):
+        events, _ = tracers[name].events_since(0)
+        assert [e["name"] for e in events] == [f"unit-{name}"]
+    names = {e["name"] for e in global_tracer.events_since(0)[0]}
+    assert not names & {"unit-a", "unit-b"}
+
+
+def test_events_since_incremental_drain():
+    tracer = trace.Tracer()
+    token = trace.push_tracer(tracer)
+    try:
+        with trace.span("one"):
+            pass
+        events, mark = tracer.events_since(0)
+        assert [e["name"] for e in events] == ["one"]
+        with trace.span("two"):
+            pass
+        events, mark = tracer.events_since(mark)
+        assert [e["name"] for e in events] == ["two"]
+        # the full list is still there for an end-of-run export
+        assert len(tracer.events_since(0)[0]) == 2
+    finally:
+        trace.pop_tracer(token)
+
+
+# ---------------------------------------------------------------------------
+# clock offset + collector merge
+# ---------------------------------------------------------------------------
+
+def test_clock_offset_midpoint_rule():
+    # server handled the request at its t=16 while our clock read
+    # 10 (send) and 12 (receive): our midpoint is 11 -> offset +5
+    assert clock_offset(10.0, 12.0, 16.0) == 5.0
+    assert clock_offset(10.0, 12.0, 6.0) == -5.0
+    assert clock_offset(10.0, 10.0, 10.0) == 0.0
+
+
+def test_collector_aligns_skewed_clocks():
+    """Two processes record one event at the SAME absolute instant;
+    process b's wall clock runs 5 s ahead (epoch_unix differs) and its
+    measured offset is -5 s — after correction the merged timestamps
+    coincide."""
+    coll = TraceCollector()
+    ev = {"name": "x", "ph": "X", "pid": 1, "tid": 1, "ts": 1000.0,
+          "dur": 5}
+    coll.ingest("a", {"events": [dict(ev)], "tracks": {"main": 1},
+                      "epoch_unix": 100.0, "clock_offset_s": 0.0})
+    coll.ingest("b", {"events": [dict(ev, name="y")],
+                      "tracks": {"main": 1},
+                      "epoch_unix": 105.0, "clock_offset_s": -5.0})
+    doc = coll.to_chrome()
+    spans = {e["name"]: e for e in doc["traceEvents"]
+             if e.get("ph") == "X" and e["name"] in ("x", "y")}
+    assert abs(spans["x"]["ts"] - spans["y"]["ts"]) < 1e-6
+    # separate process groups, and the applied offset is auditable
+    assert spans["x"]["pid"] != spans["y"]["pid"]
+    sync = [e for e in doc["traceEvents"] if e["name"] == "clock_sync"]
+    assert {s["args"]["clock_offset_s"] for s in sync} == {0.0, -5.0}
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {"a", "b"}
+
+
+def test_collector_drops_malformed_payloads():
+    coll = TraceCollector()
+    assert coll.ingest("w", None) == 0
+    assert coll.ingest("w", {"no_events": True}) == 0
+    assert coll.ingest("w", {"events": "nope"}) == 0
+    assert coll.processes() == {}
+
+
+def test_merge_trace_files_offline_and_cli(tmp_path):
+    """Per-process Tracer.export files -> one merged Perfetto file via
+    the tools/trace_merge.py CLI (the collector-wasn't-running path)."""
+    import importlib.util
+    import sys
+
+    paths = []
+    for name in ("coordinator", "worker1"):
+        tracer = trace.Tracer()
+        token = trace.push_tracer(tracer)
+        try:
+            with trace.trace_context("feed01"):
+                with trace.span(f"{name}-span"):
+                    pass
+        finally:
+            trace.pop_tracer(token)
+        path = str(tmp_path / f"{name}.json")
+        tracer.export(path, extra_meta={"clock_offset_s": 0.25}
+                      if name == "worker1" else None)
+        paths.append(path)
+    # library path
+    coll = merge_trace_files(paths)
+    assert set(coll.processes()) == {"coordinator", "worker1"}
+    # CLI path
+    spec = importlib.util.spec_from_file_location(
+        "trace_merge", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "trace_merge.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    merged = str(tmp_path / "merged.json")
+    assert mod.main([merged, *paths]) == 0
+    with open(merged) as f:
+        doc = json.load(f)
+    span_names = {e["name"] for e in doc["traceEvents"]
+                  if e.get("ph") == "X"}
+    assert {"coordinator-span", "worker1-span"} <= span_names
+    ids = {e["args"]["trace_id"] for e in doc["traceEvents"]
+           if e.get("ph") == "X" and "trace_id" in e.get("args", {})}
+    assert ids == {"feed01"}
+    sys.modules.pop("trace_merge", None)
+
+
+# ---------------------------------------------------------------------------
+# time-series sampler
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantile_interpolation():
+    # 4 observations all inside (1, 2]: p50 interpolates to the bucket
+    # midpoint, p100-ish clamps to the bucket's upper edge
+    assert abs(histogram_quantile(0.5, (1.0, 2.0), [0, 4, 0]) - 1.5) \
+        < 1e-9
+    # overflow bucket clamps to the last edge (never extrapolates)
+    assert histogram_quantile(0.99, (1.0, 2.0), [0, 0, 3]) == 2.0
+    # empty histogram: no estimate, not a crash
+    assert histogram_quantile(0.5, (1.0, 2.0), [0, 0, 0]) is None
+
+
+def test_sampler_counter_rates_gauges_histograms(tmp_path):
+    reg = metrics.MetricsRegistry()
+    spill = str(tmp_path / "history.jsonl")
+    sampler = TimeSeriesSampler(registry=reg, interval_s=1.0,
+                                capacity=4, spill_path=spill)
+    c = reg.counter("putpu_chunks_total")
+    g = reg.gauge("putpu_chunks_per_s")
+    h = reg.histogram("putpu_chunk_wall_seconds", edges=(1.0, 2.0))
+    sampler.sample(now=1000.0)
+    c.inc(10)
+    g.set(2.5)
+    for v in (1.5, 1.5, 1.5, 1.5):
+        h.observe(v)
+    point = sampler.sample(now=1002.0)
+    series = point["series"]
+    assert series["putpu_chunks_total"]["rate"] == 5.0      # 10 / 2s
+    assert series["putpu_chunks_per_s"]["value"] == 2.5
+    assert abs(series["putpu_chunk_wall_seconds"]["p50"] - 1.5) < 1e-9
+    assert series["putpu_chunk_wall_seconds"]["count"] == 4
+    assert series["putpu_chunk_wall_seconds"]["rate"] == 2.0  # 4 / 2s
+    # ring bound: capacity caps retained points
+    for i in range(10):
+        sampler.sample(now=1003.0 + i)
+    assert len(sampler.points()) == 4
+    assert len(sampler.points(last=2)) == 2
+    doc = sampler.history_doc(last=3)
+    assert doc["schema_version"] == 1 and len(doc["samples"]) == 3
+    # the JSONL spill kept MORE than the ring holds
+    with open(spill) as f:
+        lines = [json.loads(ln) for ln in f]
+    assert len(lines) == 12
+    assert lines[1]["series"]["putpu_chunks_total"]["rate"] == 5.0
+
+
+def test_history_and_alerts_endpoints():
+    reg = metrics.MetricsRegistry()
+    sampler = TimeSeriesSampler(registry=reg, interval_s=1.0)
+    reg.counter("putpu_chunks_total").inc(3)
+    sampler.sample(now=1.0)
+    sampler.sample(now=2.0)
+    engine = SLOEngine(default_slos())
+    engine.evaluate(sampler)
+    with start_obs_server(0, timeseries=sampler, slo=engine) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, doc = get_json(base + "/metrics/history")
+        assert status == 200 and len(doc["samples"]) == 2
+        status, doc = get_json(base + "/metrics/history?last=1")
+        assert len(doc["samples"]) == 1
+        status, doc = get_json(base + "/alerts")
+        assert status == 200
+        assert doc["evaluations"] == 1 and doc["alerts"] == []
+        assert {r["slo"] for r in doc["slos"]} \
+            == {s.name for s in default_slos()}
+    # unwired: 404, not 500
+    with start_obs_server(0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert get_status(base + "/metrics/history") == 404
+        assert get_status(base + "/alerts") == 404
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate arithmetic
+# ---------------------------------------------------------------------------
+
+def _ratio_points(bad_rates, t0=1000.0):
+    """Synthetic sampler-shaped points: total rate 10/s, bad as given."""
+    return [{"t": t0 + i,
+             "series": {"bad": {"rate": r, "total": 0.0},
+                        "total": {"rate": 10.0, "total": 0.0}}}
+            for i, r in enumerate(bad_rates)]
+
+
+class _FakeSeries:
+    def __init__(self, points):
+        self._points = points
+
+    def points(self, last=None):
+        return list(self._points)
+
+
+def test_burn_rate_windows_cross_fast_then_slow_exactly_once():
+    """A bad-rate step: the fast window crosses the threshold first
+    (no alert — multi-window requires BOTH), the slow window follows
+    (alert fires once), and recovery resolves it."""
+    spec = SLOSpec("x", objective=0.9, kind="ratio", bad="bad",
+                   total="total", windows=((2.0, 8.0, 5.0, "page"),),
+                   budget_window_s=20.0)
+    # 10 clean samples, then bad=8/10 (bad fraction 0.8, burn 8.0)
+    clean, dirty = [0.0] * 10, [8.0] * 10
+    pts = _ratio_points(clean + dirty)
+    t0 = pts[0]["t"]
+    # early in the step: fast window is all-dirty (burn 8 >= 5), the
+    # slow window still averages in clean samples -> no alert yet
+    t_fast_only = t0 + 11.0
+    assert spec.burn_rate(pts, 2.0, t_fast_only) >= 5.0
+    assert spec.burn_rate(pts, 8.0, t_fast_only) < 5.0
+    engine = SLOEngine([spec])
+    assert engine.evaluate(_FakeSeries(pts), now=t_fast_only) == []
+    # once sustained, the slow window crosses too -> the alert fires
+    t_both = t0 + 19.0
+    assert spec.burn_rate(pts, 8.0, t_both) >= 5.0
+    alerts = engine.evaluate(_FakeSeries(pts), now=t_both)
+    assert [a.slo for a in alerts] == ["x"]
+    assert alerts[0].severity == "page"
+    assert alerts[0].budget_remaining is not None
+    doc = engine.alerts_doc()
+    assert doc["alerts_fired_total"] == 1
+    # recovery: clean tail, both windows drop -> resolved
+    pts2 = _ratio_points(clean + dirty + [0.0] * 10)
+    assert engine.evaluate(_FakeSeries(pts2), now=t0 + 29.0) == []
+    assert engine.alerts_doc()["alerts"] == []
+
+
+def test_no_evidence_means_no_verdict():
+    """An absent series (zero traffic) must not alert OR report a
+    budget — silence is not a clean bill."""
+    spec = SLOSpec("x", objective=0.9, kind="ratio", bad="bad",
+                   total="total")
+    assert spec.burn_rate([], 60.0, 1000.0) is None
+    pts = [{"t": 1000.0, "series": {}}]
+    assert spec.burn_rate(pts, 60.0, 1000.0) is None
+    engine = SLOEngine([spec])
+    assert engine.evaluate(_FakeSeries(pts)) == []
+
+
+def test_threshold_slo_and_health_feed_resolve():
+    spec = SLOSpec("recall", objective=0.8, kind="threshold",
+                   series="putpu_canary_window_recall", field="value",
+                   bound=0.7, op=">=",
+                   windows=((2.0, 4.0, 2.0, "page"),),
+                   budget_window_s=10.0)
+    health = HealthEngine()
+    engine = SLOEngine([spec], health=health)
+    bad = [{"t": 1000.0 + i,
+            "series": {"putpu_canary_window_recall": {"value": 0.2}}}
+           for i in range(6)]
+    alerts = engine.evaluate(_FakeSeries(bad), now=1005.0)
+    assert alerts and health.verdict == "CRITICAL"
+    assert "slo:recall" in health.reasons()
+    good = bad + [{"t": 1006.0 + i,
+                   "series": {"putpu_canary_window_recall":
+                              {"value": 1.0}}} for i in range(6)]
+    assert engine.evaluate(_FakeSeries(good), now=1011.0) == []
+    assert health.verdict == "OK"
+    # the footer is one parseable ALERTS_JSON line
+    import logging
+
+    records = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    log = logging.getLogger("test-alerts")
+    log.addHandler(_Cap())
+    log.setLevel(logging.INFO)
+    engine.footer(log=log)
+    line = [m for m in records if m.startswith("ALERTS_JSON ")][0]
+    doc = json.loads(line[len("ALERTS_JSON "):])
+    assert doc["alerts_fired_total"] == 1
+
+
+def test_spec_validation_fails_fast():
+    with pytest.raises(ValueError, match="kind"):
+        SLOSpec("x", objective=0.9, kind="nope")
+    with pytest.raises(ValueError, match="objective"):
+        SLOSpec("x", objective=1.5, kind="ratio", bad="b", total="t")
+    with pytest.raises(ValueError, match="ratio needs"):
+        SLOSpec("x", objective=0.9, kind="ratio")
+    with pytest.raises(ValueError, match="threshold needs"):
+        SLOSpec("x", objective=0.9, kind="threshold")
+
+
+# ---------------------------------------------------------------------------
+# BUDGET_JSON chunk-wall percentiles
+# ---------------------------------------------------------------------------
+
+def test_budget_chunk_wall_percentiles(monkeypatch):
+    from pulsarutils_tpu.utils import logging_utils
+
+    ticks = iter(1000.0 + 0.25 * i for i in range(1, 4000))
+    monkeypatch.setattr(logging_utils.time, "perf_counter",
+                        lambda: next(ticks))
+    acct = logging_utils.BudgetAccountant()
+    acct.begin_stream()
+    # chunk walls 0.25, 0.75, 1.25, ... (each chunk consumes 2 ticks
+    # plus the bucketless overhead reads none): vary via nested buckets
+    for i in range(5):
+        with acct.chunk(i):
+            for _ in range(i):
+                with acct.bucket("pad"):
+                    pass
+    j = acct.to_json()
+    walls = sorted(c["wall_s"] for c in acct.chunks)
+    assert j["chunk_wall_s"]["p50"] == round(
+        logging_utils._percentile(walls, 0.5), 4)
+    assert j["chunk_wall_s"]["p95"] == round(
+        logging_utils._percentile(walls, 0.95), 4)
+    assert j["chunk_wall_s"]["p99"] <= walls[-1] + 1e-9
+    assert list(j)[:4] == ["schema_version", "chunks", "wall_s",
+                           "chunk_wall_s"]
+    # the histogram metric saw every chunk
+    h = metrics.REGISTRY.histogram("putpu_chunk_wall_seconds")
+    assert h._sample()["count"] >= 5
+
+
+def test_percentile_rule_matches_numpy():
+    from pulsarutils_tpu.utils.logging_utils import _percentile
+
+    vals = sorted([0.1, 0.4, 0.2, 0.9, 3.0, 0.7])
+    for q in (0.0, 0.25, 0.5, 0.9, 0.95, 1.0):
+        assert abs(_percentile(vals, q)
+                   - float(np.percentile(vals, 100 * q))) < 1e-12
+    assert _percentile([], 0.5) is None
+    assert _percentile([2.0], 0.99) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# wire round-trip + back-compat
+# ---------------------------------------------------------------------------
+
+def test_trace_context_wire_roundtrip_and_old_worker_backcompat(tmp_path):
+    fname = write_file(tmp_path / "a.fil", seed=21)
+    collector = TraceCollector()
+    with FleetCoordinator(str(tmp_path / "fleet"), auto_sweep=False,
+                          collector=collector) as coordinator:
+        with start_obs_server(0, fleet=coordinator) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            coordinator.add_survey([fname], **CONFIG)
+            reg = protocol.post_json(base + "/fleet/register",
+                                     {"healthz_url": None})
+            # clock-sync anchor rides register AND lease responses
+            assert isinstance(reg["server_time"], float)
+            resp = protocol.post_json(
+                base + "/fleet/lease",
+                {"worker": reg["worker"], "max_units": 2})
+            assert isinstance(resp["server_time"], float)
+            leases = resp["leases"]
+            # every lease is stamped; same unit -> stable trace_id
+            for lease in leases:
+                ctx = protocol.clean_trace_context(lease["trace"])
+                assert len(ctx["trace_id"]) == 16
+            assert leases[0]["trace"]["trace_id"] \
+                != leases[1]["trace"]["trace_id"]
+            # an OLD worker completes with NO trace field: accepted
+            old = protocol.post_json(base + "/fleet/complete", {
+                "worker": reg["worker"], "lease": leases[0]["lease"],
+                "unit": leases[0]["unit"], "error": None})
+            assert old["ok"] is True
+            assert collector.processes() == {}
+            # a NEW worker ships drained spans: stitched under its id
+            protocol.post_json(base + "/fleet/complete", {
+                "worker": reg["worker"], "lease": leases[1]["lease"],
+                "unit": leases[1]["unit"], "error": None,
+                "trace": {"events": [
+                    {"name": "unit", "ph": "X", "pid": 1, "tid": 1,
+                     "ts": 0.0, "dur": 10.0,
+                     "args": {"trace_id":
+                              leases[1]["trace"]["trace_id"]}}],
+                    "tracks": {"main": 1}, "epoch_unix": 50.0,
+                    "clock_offset_s": 0.125}})
+            assert collector.processes() \
+                == {f"worker {reg['worker']}": 1}
+    # requeued steals keep the unit's trace id (one timeline per unit)
+    with FleetCoordinator(str(tmp_path / "fleet2"),
+                          auto_sweep=False) as c2:
+        c2.add_survey([fname], **CONFIG)
+        w = c2.register({})["worker"]
+        lease = c2.lease({"worker": w, "max_units": 1})["leases"][0]
+        import time as _t
+        c2.sweep(now=_t.monotonic() + 120.0)      # expire it
+        again = c2.lease({"worker": w, "max_units": 1})["leases"][0]
+        assert again["unit"] == lease["unit"]
+        assert again["trace"]["trace_id"] == lease["trace"]["trace_id"]
+
+
+def test_resent_complete_does_not_double_ingest_spans(tmp_path):
+    """A wire-level resend of the same complete message (lost
+    response -> retry, identical body incl. trace seq) must not render
+    every span twice in the merged trace; a later payload with a
+    higher seq still lands."""
+    fname = write_file(tmp_path / "a.fil", seed=22)
+    collector = TraceCollector()
+    with FleetCoordinator(str(tmp_path / "fleet"), auto_sweep=False,
+                          collector=collector) as coordinator:
+        coordinator.add_survey([fname], **CONFIG)
+        w = coordinator.register({})["worker"]
+        leases = coordinator.lease({"worker": w,
+                                    "max_units": 2})["leases"]
+
+        def complete_doc(lease, seq):
+            return {"worker": w, "lease": lease["lease"],
+                    "unit": lease["unit"], "error": None,
+                    "trace": {"events": [
+                        {"name": "unit", "ph": "X", "pid": 1, "tid": 1,
+                         "ts": float(seq), "dur": 1.0}],
+                        "tracks": {"main": 1}, "epoch_unix": 0.0,
+                        "clock_offset_s": 0.0, "seq": seq}}
+
+        coordinator.complete(complete_doc(leases[0], 1))
+        assert collector.processes() == {f"worker {w}": 1}
+        coordinator.complete(complete_doc(leases[0], 1))   # resend
+        assert collector.processes() == {f"worker {w}": 1}
+        coordinator.complete(complete_doc(leases[1], 2))   # fresh
+        assert collector.processes() == {f"worker {w}": 2}
+        # a seq-less payload (old traced worker) still ingests
+        doc = complete_doc(leases[1], 3)
+        del doc["trace"]["seq"]
+        coordinator.complete(doc)
+        assert collector.processes() == {f"worker {w}": 3}
+
+
+def test_malformed_lease_trace_runs_unit_untraced(tmp_path):
+    """A forward-incompatible trace context (e.g. a future TRACE_KEYS
+    member) must degrade the unit to UNTRACED — never crash the worker
+    mid-lease (the back-compat promise holds in both directions)."""
+    fname = write_file(tmp_path / "a.fil", seed=23)
+    with FleetCoordinator(str(tmp_path / "fleet"),
+                          auto_sweep=False) as coordinator:
+        with start_obs_server(0, fleet=coordinator) as srv:
+            url = f"http://127.0.0.1:{srv.port}"
+            coordinator.add_survey([fname], **CONFIG)
+            worker = FleetWorker(url, http_port=None)
+            orig = worker._post
+
+            def poison(path, doc, **kw):
+                resp = orig(path, doc, **kw)
+                for lease in (resp.get("leases") or []):
+                    lease["trace"] = {"trace_id": "x" * 16,
+                                      "future_key": 1}
+                return resp
+
+            worker._post = poison
+            worker.run(max_idle_s=30.0)
+            assert worker.units_done == 2
+            assert coordinator.survey_done
+
+
+def test_clock_offset_refreshes_on_lease_and_skips_retry_windows():
+    """_update_clock_offset uses the successful attempt's bracket only
+    and refreshes per exchange (a long-lived worker tracks drift)."""
+    worker = FleetWorker("http://127.0.0.1:9", http_port=None)
+    worker._update_clock_offset({"t0": 10.0, "t1": 12.0},
+                                {"server_time": 16.0})
+    assert worker.clock_offset_s == 5.0
+    # later exchange refreshes the estimate
+    worker._update_clock_offset({"t0": 100.0, "t1": 100.0},
+                                {"server_time": 101.0})
+    assert worker.clock_offset_s == 1.0
+    # no server_time (old coordinator) / no timing: keep the estimate
+    worker._update_clock_offset({}, {"server_time": 999.0})
+    worker._update_clock_offset({"t0": 0.0, "t1": 0.0}, {})
+    assert worker.clock_offset_s == 1.0
+
+
+def test_failed_complete_keeps_spans_for_the_next_drain():
+    """A complete that dies past its retries must NOT lose the drained
+    span window — the cursor commits only after the post lands."""
+    worker = FleetWorker("http://127.0.0.1:9", http_port=None,
+                         trace=True)
+    worker.worker_id = "w1"
+    worker.tracer = trace.Tracer()
+    token = trace.push_tracer(worker.tracer)
+    try:
+        with trace.span("unit"):
+            pass
+    finally:
+        trace.pop_tracer(token)
+    lease = {"lease": "L1", "unit": "u1"}
+    calls = []
+
+    def failing_post(path, doc, **kw):
+        calls.append(doc)
+        raise OSError("coordinator gone")
+
+    worker._post = failing_post
+    with pytest.raises(OSError):
+        worker._complete(lease, None)
+    assert len(calls[0]["trace"]["events"]) == 1
+    assert worker._trace_mark == 0 and worker._trace_seq == 0
+
+    def ok_post(path, doc, **kw):
+        calls.append(doc)
+        return {"ok": True}
+
+    worker._post = ok_post
+    worker._complete(lease, None)
+    # the retry re-ships the SAME events under the same seq
+    assert calls[1]["trace"]["events"] == calls[0]["trace"]["events"]
+    assert calls[1]["trace"]["seq"] == calls[0]["trace"]["seq"] == 1
+    assert worker._trace_mark == 1 and worker._trace_seq == 1
+
+
+def test_note_alert_deescalates_with_the_raiser():
+    """A page that subsides to a ticket must drop /healthz from
+    CRITICAL to DEGRADED — external conditions track the raiser's
+    severity in both directions."""
+    h = HealthEngine()
+    h.note_alert("slo:x", "CRITICAL", "page burn")
+    assert h.verdict == "CRITICAL"
+    h.note_alert("slo:x", "DEGRADED", "ticket burn only")
+    assert h.verdict == "DEGRADED"
+    h.resolve_alert("slo:x")
+    assert h.verdict == "OK"
+
+
+def test_alerts_doc_before_first_evaluation_names_slos():
+    engine = SLOEngine(default_slos())
+    doc = engine.alerts_doc()
+    assert [r["slo"] for r in doc["slos"]] \
+        == [s.name for s in default_slos()]
+    assert all(r["slo"] for r in engine.to_json()["slos"])
+
+
+def test_points_last_zero_returns_nothing():
+    sampler = TimeSeriesSampler(registry=metrics.MetricsRegistry())
+    sampler.sample(now=1.0)
+    sampler.sample(now=2.0)
+    assert sampler.points(last=0) == []
+    assert len(sampler.points(last=1)) == 1
+
+
+def test_clean_trace_context_validation():
+    assert protocol.clean_trace_context(None) is None
+    ctx = protocol.clean_trace_context(
+        {"trace_id": "ab" * 8, "parent_span_id": "3"})
+    assert ctx == {"trace_id": "ab" * 8, "parent_span_id": "3"}
+    with pytest.raises(ValueError, match="not in"):
+        protocol.clean_trace_context({"trace_id": "x", "evil": 1})
+    with pytest.raises(ValueError, match="trace_id"):
+        protocol.clean_trace_context({"parent_span_id": "3"})
+    with pytest.raises(ValueError, match="JSON object"):
+        protocol.clean_trace_context("abc")
+
+
+# ---------------------------------------------------------------------------
+# byte identity: the whole layer on vs off
+# ---------------------------------------------------------------------------
+
+def _armed_obs_layer():
+    """Arm tracing + time-series + SLO globally; returns a closer."""
+    tracer = trace.start_tracing()
+    engine = SLOEngine()
+    sampler = TimeSeriesSampler(interval_s=0.1,
+                                on_sample=lambda _p:
+                                engine.evaluate(sampler))
+    sampler.start()
+
+    def close():
+        sampler.stop()
+        engine.evaluate(sampler)
+        trace.stop_tracing()
+        return tracer, sampler, engine
+
+    return close
+
+
+def test_search_by_chunks_byte_inert_with_layer_armed(tmp_path):
+    fname = write_file(tmp_path / "a.fil", seed=30, pulse=True)
+    search_by_chunks(fname, output_dir=str(tmp_path / "off"),
+                     make_plots=False, progress=False, **CONFIG)
+    close = _armed_obs_layer()
+    try:
+        search_by_chunks(fname, output_dir=str(tmp_path / "on"),
+                         make_plots=False, progress=False, **CONFIG)
+    finally:
+        tracer, sampler, engine = close()
+    assert snapshot_dir(tmp_path / "off") == snapshot_dir(tmp_path / "on")
+    # and the armed run actually observed: spans + samples + evals
+    assert len(tracer.events_since(0)[0]) > 0
+    assert engine.alerts_doc()["evaluations"] > 0
+
+
+def test_multibeam_byte_inert_with_layer_armed(tmp_path):
+    from pulsarutils_tpu.beams.multibeam import multibeam_search
+
+    fnames = [write_file(tmp_path / f"b{i}.fil", seed=40 + i,
+                         pulse=(i == 0)) for i in range(2)]
+    multibeam_search(fnames, 100, 200, snr_threshold=6.5,
+                     chunk_length=8192 * TSAMP,
+                     output_dir=str(tmp_path / "off"), resume=True)
+    close = _armed_obs_layer()
+    try:
+        multibeam_search(fnames, 100, 200, snr_threshold=6.5,
+                         chunk_length=8192 * TSAMP,
+                         output_dir=str(tmp_path / "on"), resume=True)
+    finally:
+        close()
+    assert snapshot_dir(tmp_path / "off") == snapshot_dir(tmp_path / "on")
+
+
+def test_two_worker_fleet_traced_byte_identical_one_merged_trace(tmp_path):
+    """The ISSUE 14 acceptance shape: a 2-worker fleet run with
+    tracing + time-series + SLO fully armed produces candidates and
+    ledgers byte-identical to the plain single-process run, and ONE
+    merged Perfetto trace where a lease's coordinator and worker spans
+    share a trace_id on separate process groups."""
+    fnames = [write_file(tmp_path / "a.fil", seed=0, pulse=True),
+              write_file(tmp_path / "b.fil", seed=1)]
+    for fname in fnames:
+        search_by_chunks(fname, output_dir=str(tmp_path / "single"),
+                         make_plots=False, progress=False, **CONFIG)
+
+    collector = TraceCollector()
+    tracer = trace.start_tracing()
+    engine = SLOEngine()
+    sampler = TimeSeriesSampler(interval_s=0.2,
+                                on_sample=lambda _p:
+                                engine.evaluate(sampler))
+    sampler.start()
+    out = tmp_path / "fleet"
+    try:
+        with FleetCoordinator(str(out), lease_ttl_s=120.0,
+                              probe_interval_s=0.3,
+                              collector=collector) as coordinator:
+            with start_obs_server(0, fleet=coordinator,
+                                  timeseries=sampler,
+                                  slo=engine) as srv:
+                url = f"http://127.0.0.1:{srv.port}"
+                coordinator.add_survey(fnames, **CONFIG)
+                workers = [FleetWorker(url, http_port=0, trace=True,
+                                       history_interval_s=0.2)
+                           for _ in range(2)]
+                threads = [threading.Thread(
+                    target=w.run, kwargs={"max_idle_s": 60.0})
+                    for w in workers]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300.0)
+                assert coordinator.survey_done
+                summary = coordinator.summary()
+    finally:
+        sampler.stop()
+        trace.stop_tracing()
+    collector.ingest_tracer("coordinator", tracer)
+
+    # 1. science bytes: fleet+layer == plain single-process
+    assert snapshot_dir(tmp_path / "single") == snapshot_dir(out)
+
+    # 2. one merged, loadable trace; coordinator + worker spans of one
+    # lease share a trace_id on separate process groups
+    merged_path = str(tmp_path / "merged.json")
+    assert collector.export(merged_path) > 0
+    with open(merged_path) as f:
+        doc = json.load(f)
+    pid_names = {e["pid"]: e["args"]["name"]
+                 for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+    lease_spans = [e for e in doc["traceEvents"]
+                   if e.get("ph") == "b" and e["name"] == "lease"]
+    unit_spans = [e for e in doc["traceEvents"]
+                  if e.get("ph") == "X" and e["name"] == "unit"]
+    assert lease_spans and unit_spans
+    shared = 0
+    for lease_ev in lease_spans:
+        for unit_ev in unit_spans:
+            if unit_ev["args"]["trace_id"] \
+                    == lease_ev["args"]["trace_id"]:
+                assert pid_names[lease_ev["pid"]] == "coordinator"
+                assert pid_names[unit_ev["pid"]].startswith("worker ")
+                assert lease_ev["pid"] != unit_ev["pid"]
+                shared += 1
+    assert shared == len(unit_spans) == 4
+    # every worker that completed units contributed spans
+    traced = {pid_names[e["pid"]] for e in unit_spans}
+    assert traced >= {f"worker {w.worker_id}" for w in workers
+                      if w.units_done > 0}
+    # driver chunk spans rode along under the lease trace ids
+    chunk_spans = [e for e in doc["traceEvents"]
+                   if e.get("ph") == "X" and e["name"] == "chunk"]
+    assert chunk_spans
+    assert all("trace_id" in e["args"] for e in chunk_spans)
+
+    # 3. SLOs evaluated; per-worker histories scraped into the summary
+    assert engine.alerts_doc()["evaluations"] > 0
+    assert summary["survey_done"]
+    history = summary.get("history") or {}
+    assert set(history) == {w.worker_id for w in workers}

@@ -1,0 +1,51 @@
+"""trace_merge: stitch per-process span traces into ONE Perfetto file.
+
+Post-hoc counterpart of the live fleet trace collector (ISSUE 14): when
+the coordinator ran without ``--trace-out`` (or you only have the
+per-process artifacts), merge the ``Tracer.export`` JSON files each
+role wrote into a single clock-aligned timeline::
+
+    python tools/trace_merge.py merged.json \\
+        coordinator_trace.json worker1_trace.json worker2_trace.json
+
+Each input needs the ``putpu.epoch_unix`` wall-clock anchor the tracer
+stamps on export (files without it merge at offset 0 with a warning);
+an optional ``putpu.clock_offset_s`` (the worker's measured midpoint
+offset vs the coordinator) corrects skew exactly as the live collector
+would.  Load the output at <https://ui.perfetto.dev> — one process
+group per input file, the applied correction recorded on each group's
+``clock_sync`` span.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pulsarutils_tpu.obs.collector import merge_trace_files  # noqa: E402
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="trace_merge",
+        description="merge per-process span-trace JSON files into one "
+                    "Perfetto-loadable trace (clock-skew corrected)")
+    parser.add_argument("output", help="merged trace path")
+    parser.add_argument("traces", nargs="+",
+                        help="per-process Tracer.export JSON files")
+    parser.add_argument("--names", nargs="*", default=None,
+                        help="process-group names (default: file stems)")
+    opts = parser.parse_args(argv)
+    if opts.names and len(opts.names) != len(opts.traces):
+        parser.error("--names must match the number of trace files")
+    collector = merge_trace_files(opts.traces, names=opts.names)
+    n = collector.export(opts.output)
+    print(f"trace_merge: {n} spans from {len(opts.traces)} file(s) -> "
+          f"{opts.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
