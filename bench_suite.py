@@ -56,6 +56,13 @@ all). Failures in one config don't stop the others.
      overhead), forced to 0.0 on any candidate/ledger byte divergence,
      a merged trace missing a completing worker's spans, or zero SLO
      evaluations
+ 19  killed-coordinator restart A/B (ISSUE 15): the same fleet survey
+     uninterrupted vs coordinator killed mid-survey (one unit done,
+     one lease stranded) and restarted via recover() — journal
+     replay, ledger re-derive, epoch-fenced re-steal — value =
+     uninterrupted/recovered wall, forced to 0.0 on any
+     ledger/candidate byte divergence or a recovery that did not
+     actually recover
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -1435,11 +1442,164 @@ def config18(quick):
           "on_wall_s": round(on["wall"], 2)})
 
 
+def config19(quick):
+    """Killed-coordinator restart A/B (ISSUE 15): the same one-file
+    survey run through a 1-worker fleet twice —
+
+    * **uninterrupted arm** — coordinator up for the whole survey;
+    * **killed arm** — the worker completes ONE unit, a second lease
+      is left stranded in flight, and the coordinator is killed (its
+      in-memory state dropped; only the per-event-flushed
+      ``fleet_journal.jsonl`` and the ledgers survive — exactly what a
+      SIGKILL leaves).  ``FleetCoordinator.recover()`` replays the
+      journal, re-derives outstanding units from the ledgers, re-steals
+      the stranded lease under a bumped fencing epoch, and a fresh
+      worker finishes.
+
+    ``value`` is the uninterrupted/killed-and-recovered wall ratio
+    (restart overhead; ~1.0 expected) — FORCED to 0.0, far past any
+    tolerance, when any per-file ledger or candidate byte diverges
+    between the arms, when either survey fails to finish, or when the
+    recovery did not actually recover (no stranded lease re-stolen, no
+    epoch bump): "the coordinator died" must be a restart, never a
+    different answer.
+    """
+    import glob
+    import tempfile
+
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    tsamp, nchan = 0.0005, 64
+    hop = 4096 if quick else 8192
+    nhops = 4
+    nsamples = nhops * hop
+    config = dict(dmmin=100, dmmax=200, chunk_length=hop * tsamp,
+                  snr_threshold=6.5)
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.default_rng(190)
+        arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+        arr[:, (3 * nsamples) // 4] += 4.0
+        arr = disperse_array(arr, 150.0, 1200., 200., tsamp)
+        header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": tsamp,
+                  "foff": 200. / nchan}
+        fname = os.path.join(tmp, "survey.fil")
+        write_simulated_filterbank(fname, arr, header, descending=True)
+
+        def run_fleet(outdir, kill_mid_survey):
+            t0 = time.time()
+            coordinator = FleetCoordinator(outdir, lease_ttl_s=120.0,
+                                           chunks_per_unit=1,
+                                           auto_sweep=False)
+            server = start_obs_server(0, fleet=coordinator)
+            url = f"http://127.0.0.1:{server.port}"
+            coordinator.add_survey([fname], **config)
+            recovery = {"stranded": 0, "epoch_bumped": False,
+                        "units_before_kill": None}
+            if kill_mid_survey:
+                worker = FleetWorker(url, http_port=None)
+                orig = worker._run_unit
+
+                def drain_after_first(lease):
+                    result = orig(lease)
+                    worker.drain()
+                    return result
+
+                worker._run_unit = drain_after_first
+                worker.run()
+                recovery["units_before_kill"] = worker.units_done
+                ghost = coordinator.register({})["worker"]
+                stranded = coordinator.lease(
+                    {"worker": ghost, "max_units": 1})["leases"]
+                recovery["stranded"] = len(stranded)
+                server.close()
+                coordinator.close()
+                del coordinator          # the kill
+                coordinator = FleetCoordinator.recover(
+                    outdir, lease_ttl_s=120.0, chunks_per_unit=1,
+                    auto_sweep=False)
+                if stranded:
+                    unit = coordinator._units.get(stranded[0]["unit"])
+                    recovery["epoch_bumped"] = (
+                        unit is not None
+                        and unit.epoch > stranded[0]["epoch"])
+                server = start_obs_server(0, fleet=coordinator)
+                url = f"http://127.0.0.1:{server.port}"
+            finisher = FleetWorker(url, http_port=None)
+            finisher.run(max_idle_s=120.0)
+            done = coordinator.survey_done
+            stats = coordinator.progress_doc()["stats"]
+            server.close()
+            coordinator.close()
+            return {"wall": time.time() - t0, "done": done,
+                    "stats": stats, **recovery}
+
+        base = run_fleet(os.path.join(tmp, "uninterrupted"),
+                         kill_mid_survey=False)
+        killed = run_fleet(os.path.join(tmp, "killed"),
+                           kill_mid_survey=True)
+
+        # identity: ledger raw bytes + candidate npz member bytes (the
+        # chaos-drill rule; fence/journal sidecars are control-plane
+        # state, not science output)
+        identical = base["done"] and killed["done"]
+        names = {os.path.basename(p)
+                 for d in ("uninterrupted", "killed")
+                 for p in glob.glob(os.path.join(tmp, d,
+                                                 "progress_*.json"))
+                 + glob.glob(os.path.join(tmp, d, "*.npz"))}
+        for name in sorted(names):
+            a_path = os.path.join(tmp, "uninterrupted", name)
+            b_path = os.path.join(tmp, "killed", name)
+            if not (os.path.exists(a_path) and os.path.exists(b_path)):
+                identical = False
+                log(f"config 19: {name} present in only one arm")
+                continue
+            if name.endswith(".json"):
+                with open(a_path, "rb") as fa, open(b_path, "rb") as fb:
+                    if fa.read() != fb.read():
+                        identical = False
+                        log(f"config 19: ledger bytes differ: {name}")
+            else:
+                with np.load(a_path, allow_pickle=False) as za, \
+                        np.load(b_path, allow_pickle=False) as zb:
+                    if set(za.files) != set(zb.files) or any(
+                            za[k].tobytes() != zb[k].tobytes()
+                            or za[k].dtype != zb[k].dtype
+                            or za[k].shape != zb[k].shape
+                            for k in za.files):
+                        identical = False
+                        log(f"config 19: candidate bytes differ: {name}")
+
+    recovered = bool(killed["stranded"]) and killed["epoch_bumped"] \
+        and killed["units_before_kill"] == 1
+    ok = identical and recovered
+    ratio = base["wall"] / killed["wall"] if killed["wall"] else 0.0
+    emit({"config": 19, "metric": "killed-coordinator restart A/B, "
+          f"{nchan}x{nsamples}, journal replay + ledger re-derive + "
+          "epoch-fenced re-steal over the /fleet/ wire",
+          "value": round(ratio, 4) if ok else 0.0,
+          "unit": "x (uninterrupted/recovered wall; 0 = identity or "
+                  "recovery failure)",
+          "identical": identical,
+          "surveys_done": [base["done"], killed["done"]],
+          "units_before_kill": killed["units_before_kill"],
+          "stranded_leases": killed["stranded"],
+          "epoch_bumped": killed["epoch_bumped"],
+          "killed_stats": killed["stats"],
+          "uninterrupted_wall_s": round(base["wall"], 2),
+          "recovered_wall_s": round(killed["wall"], 2)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13, 14, 15, 16, 17, 18])
+                                 13, 14, 15, 16, 17, 18, 19])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -1468,7 +1628,8 @@ def main(argv=None):
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16, 17: config17, 18: config18}
+           15: config15, 16: config16, 17: config17, 18: config18,
+           19: config19}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

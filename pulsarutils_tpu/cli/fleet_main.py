@@ -39,8 +39,17 @@ def build_parser():
     coord = sub.add_parser("coordinator",
                            help="shard files into leased units and "
                                 "serve the fleet protocol")
-    coord.add_argument("fnames", nargs="+",
-                       help="filterbank files to shard across the fleet")
+    coord.add_argument("fnames", nargs="*",
+                       help="filterbank files to shard across the fleet "
+                            "(optional with --recover: the journal "
+                            "already names the crashed run's files)")
+    coord.add_argument("--recover", action="store_true",
+                       help="restart a crashed coordinator: replay "
+                            "fleet_journal.jsonl from --output-dir, "
+                            "re-derive outstanding units from the "
+                            "ledgers, re-steal in-flight leases under "
+                            "a bumped epoch, and keep serving — "
+                            "workers re-register automatically")
     coord.add_argument("--output-dir", required=True,
                        help="shared directory for ledgers + candidates "
                             "(every worker must see the same files)")
@@ -165,18 +174,36 @@ def _run_coordinator(opts):
             sampler = TimeSeriesSampler(interval_s=history_interval)
         sampler.start()
 
-    coordinator = FleetCoordinator(
-        opts.output_dir, lease_ttl_s=opts.lease_ttl,
-        chunks_per_unit=opts.chunks_per_unit,
-        probe_interval_s=opts.probe_interval,
-        resume=not opts.no_resume, collector=collector)
+    kwargs = dict(lease_ttl_s=opts.lease_ttl,
+                  chunks_per_unit=opts.chunks_per_unit,
+                  probe_interval_s=opts.probe_interval,
+                  resume=not opts.no_resume, collector=collector)
+    if opts.recover:
+        # crash restart (ISSUE 15): journal replay + ledger re-derive;
+        # files the journal already names must not be re-sharded
+        coordinator = FleetCoordinator.recover(opts.output_dir, **kwargs)
+        known = {f["fname"] for f in
+                 coordinator.progress_doc()["files"]}
+        fnames = [f for f in opts.fnames
+                  if os.path.abspath(str(f)) not in known]
+        if len(fnames) < len(opts.fnames):
+            logger.info("fleet: %d file(s) already recovered from the "
+                        "journal, not re-sharding them",
+                        len(opts.fnames) - len(fnames))
+    else:
+        if not opts.fnames:
+            raise SystemExit("PUfleet coordinator: provide filterbank "
+                             "files to shard (or --recover)")
+        coordinator = FleetCoordinator(opts.output_dir, **kwargs)
+        fnames = opts.fnames
     server = start_obs_server(opts.http_port, host=opts.http_host,
                               fleet=coordinator, timeseries=sampler,
                               slo=engine, health=health)
     logger.info("fleet coordinator on http://%s:%d — workers: "
                 "PUfleet worker --coordinator http://%s:%d",
                 opts.http_host, server.port, opts.http_host, server.port)
-    coordinator.add_survey(opts.fnames, **config)
+    if fnames:
+        coordinator.add_survey(fnames, **config)
     try:
         while True:
             time.sleep(1.0)

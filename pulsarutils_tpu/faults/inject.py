@@ -27,6 +27,14 @@ site       seam                                                 kinds
            (``periodicity/driver.py``, ISSUE 13) — any raise
            degrades the sweep to its numpy reference path, so
            the chaos class proves candidates survive it
+``wire``   the fleet wire client (``protocol.post_json_retry``, ``drop``, ``delay``,
+           ISSUE 15) — partition chaos per message: ``drop``    ``duplicate``
+           raises a synthetic transport error (the request
+           never lands), ``delay`` sleeps ``seconds`` before
+           sending, ``duplicate`` sends the message twice.
+           The optional ``msg`` selector restricts a spec to
+           one message name (``register``/``lease``/
+           ``complete``/``release``); ``None`` matches all
 ========== ==================================================== ==========================
 
 ``kind="oom"`` (ISSUE 12) raises a *real* ``XlaRuntimeError``-shaped
@@ -92,6 +100,9 @@ _SITE_DEFAULT_EXC = {"read": "OSError", "persist": "OSError"}
 _CORRUPT_KINDS = ("nan", "inf", "dead_channels", "zero_run", "saturate",
                   "impulse")
 
+#: partition-chaos kinds for the ``wire`` site (ISSUE 15)
+_WIRE_KINDS = ("drop", "delay", "duplicate")
+
 
 def _resource_exhausted_exc(site, chunk):
     """An injected OOM shaped exactly like production's: jaxlib's own
@@ -127,6 +138,7 @@ class FaultSpec:
     seed: int = 0                   # corruption rng seed (mixed w/ chunk)
     exc: str | None = None          # exception class name for kind=error
     amp: float = 20.0               # impulse amplitude, in block stds
+    msg: str | None = None          # wire-message selector; None = all
     fired: int = dataclasses.field(default=0, init=False)
 
     def matches(self, site, chunk):
@@ -146,6 +158,8 @@ class FaultSpec:
             d["exc"] = self.exc
         if self.amp != 20.0:  # only when non-default: pre-existing plan
             d["amp"] = self.amp  # JSON stays byte-stable
+        if self.msg is not None:
+            d["msg"] = self.msg
         return d
 
 
@@ -197,6 +211,21 @@ class FaultPlan:
             exc_cls = _EXC_TYPES.get(exc_name, RuntimeError)
             raise exc_cls(f"FAULTPLAN: injected {site} {spec.kind} "
                           f"(chunk={chunk})")
+
+    def wire_action(self, site, msg=None):
+        """First matching wire-chaos action: ``(kind, seconds)`` for
+        ``drop``/``delay``/``duplicate`` specs, or ``None``.  A spec's
+        ``msg`` selector restricts it to one wire message name."""
+        for spec in self.specs:
+            if spec.kind not in _WIRE_KINDS or spec.site != site:
+                continue
+            if spec.msg is not None and msg is not None \
+                    and spec.msg != msg:
+                continue
+            if not self._claim(spec):
+                continue
+            return spec.kind, spec.seconds
+        return None
 
     def truncated_length(self, site, chunk, n):
         """Shortened read length for matching ``truncate`` specs."""
@@ -366,3 +395,10 @@ def truncated_length(site, chunk, n):
     if plan is None or _SUPPRESS:
         return n
     return plan.truncated_length(site, chunk, n)
+
+
+def wire_action(site, msg=None):
+    plan = _ACTIVE if _ACTIVE is not None or _ENV_CHECKED else active()
+    if plan is None or _SUPPRESS:
+        return None
+    return plan.wire_action(site, msg=msg)

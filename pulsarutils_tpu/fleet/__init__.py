@@ -14,7 +14,12 @@ hosts:
 * :mod:`.worker` — :class:`~.worker.FleetWorker`: wraps
   ``search_by_chunks`` per leased unit, reports completions with its
   metrics snapshot + health verdict, and drains gracefully on
-  SIGTERM/SIGINT.
+  SIGTERM/SIGINT;
+* :mod:`.journal` — :class:`~.journal.FleetJournal` (ISSUE 15): the
+  coordinator's write-ahead ``fleet_journal.jsonl``, replayed by
+  :meth:`~.coordinator.FleetCoordinator.recover` so a SIGKILLed
+  coordinator restarts as a non-event; monotonic lease **epochs**
+  double as fencing tokens against partitioned zombie workers.
 
 See ``docs/fleet.md`` for the deployment model and the lease/steal
 failure matrix.

@@ -61,9 +61,15 @@ class _Unit:
     only ever shrinks (grant-time ledger check drops finished ones).
     ``trace_id`` is the unit's distributed-trace identity (ISSUE 14):
     every lease of this unit — across steals and requeues — carries the
-    same id, so the merged trace shows ONE causal timeline per unit."""
+    same id, so the merged trace shows ONE causal timeline per unit.
+    ``epoch`` is the unit's monotonic **fencing token** (ISSUE 15): it
+    bumps on every requeue/steal/reshard (and on coordinator recovery
+    of an in-flight unit), rides every grant, and makes a partitioned
+    zombie's late completes/releases/artifact-writes detectably stale —
+    the classic lease-fencing rule."""
 
-    __slots__ = ("id", "fname", "chunks", "attempts", "state", "trace_id")
+    __slots__ = ("id", "fname", "chunks", "attempts", "state",
+                 "trace_id", "epoch")
 
     def __init__(self, unit_id, fname, chunks):
         self.id = unit_id
@@ -72,11 +78,13 @@ class _Unit:
         self.attempts = 0
         self.state = "pending"      # pending | leased | done | failed
         self.trace_id = _trace.new_trace_id()
+        self.epoch = 1
 
     def doc(self):
         return {"unit": self.id, "fname": self.fname,
                 "chunks": list(self.chunks), "state": self.state,
-                "attempts": self.attempts, "trace_id": self.trace_id}
+                "attempts": self.attempts, "epoch": self.epoch,
+                "trace_id": self.trace_id}
 
 
 class _Lease:
@@ -152,9 +160,19 @@ class FleetCoordinator:
                  probe_interval_s=1.0, probe_timeout_s=2.0, dead_after=3,
                  poll_s=0.25, resume=True, file_affinity=True,
                  max_attempts=5, auto_sweep=True, collector=None,
-                 scrape_history=True):
+                 scrape_history=True, journal=True):
+        from .journal import FleetJournal
+
         self.output_dir = str(output_dir)
         os.makedirs(self.output_dir, exist_ok=True)
+        #: the write-ahead journal (ISSUE 15): every survey addition,
+        #: unit plan, grant, requeue/epoch bump, failure and duplicate
+        #: lands in ``fleet_journal.jsonl`` beside the ledgers BEFORE
+        #: the reply leaves, so :meth:`recover` can rebuild this
+        #: object's control-plane state after a SIGKILL.  ``journal=
+        #: False`` disables it (byte-inert: the file is never created).
+        self.journal = (FleetJournal.in_dir(self.output_dir)
+                        if journal else FleetJournal(None))
         #: a :class:`~pulsarutils_tpu.obs.collector.TraceCollector` (or
         #: None): wired, every completion's drained worker spans are
         #: stitched into the fleet trace (ISSUE 14)
@@ -182,7 +200,7 @@ class FleetCoordinator:
         self._trace_seqs = {}     # worker id -> last ingested trace seq
         self._stats = {"granted": 0, "expired": 0, "revoked": 0,
                        "denied": 0, "requeued": 0, "completed": 0,
-                       "failed": 0, "duplicates": 0}
+                       "failed": 0, "duplicates": 0, "stale_epochs": 0}
         self._closed = False
         self._sweeper = None
         if auto_sweep:
@@ -302,6 +320,7 @@ class FleetCoordinator:
                         f"{fname} is already sharded under a different "
                         "search config — one fleet run, one fingerprint "
                         "per file")
+                already = fname in self._files
                 self._files[fname] = {
                     "fingerprint": sp["fingerprint"], "config": config,
                     "root": sp["root"], "workload": workload,
@@ -309,6 +328,11 @@ class FleetCoordinator:
                     "chunks_total": len(sp["chunk_starts"]),
                     "chunk_starts": list(sp["chunk_starts"]),
                     "chunk_est_bytes": int(chunk_est)}
+                if not already:
+                    # WAL first (ISSUE 15): the file definition must be
+                    # durable before any unit of it can be granted
+                    self.journal.append("file", fname=fname,
+                                        **self._files[fname])
                 per_unit = (max(len(starts), 1)
                             if workload == "periodicity"
                             else self.chunks_per_unit)
@@ -319,6 +343,10 @@ class FleetCoordinator:
                     self._units[unit.id] = unit
                     self._pending.append(unit.id)
                     ids.append(unit.id)
+                    self.journal.append("unit", unit=unit.id,
+                                        fname=fname,
+                                        chunks=list(unit.chunks),
+                                        trace_id=unit.trace_id)
                 logger.info(
                     "fleet: sharded %s into %d unit(s) (%d of %d chunks "
                     "pending, fingerprint %s)", os.path.basename(fname),
@@ -351,6 +379,151 @@ class FleetCoordinator:
                 "or drop them")
         config = {k: v for k, v in spec.items() if k != "fname"}
         return self.add_survey([spec["fname"]], **config)
+
+    # -- crash recovery (ISSUE 15) -------------------------------------------
+
+    @classmethod
+    def recover(cls, output_dir, **kwargs):
+        """Restart a crashed coordinator from its write-ahead journal.
+
+        Rebuilds the control-plane state a SIGKILL destroyed — file
+        definitions, unit plans, attempt counts, fencing epochs,
+        failures, duplicate/stale counters — by replaying
+        ``fleet_journal.jsonl``, then re-derives every unit's
+        *outstanding* chunks from the per-file ledgers (the ledger
+        stays the only completion record; the journal is never trusted
+        for done-ness).  Units that were leased at the crash are
+        requeued with a **bumped epoch**, so a zombie worker still
+        computing on a pre-crash grant is fenced exactly as if its
+        lease had been stolen.  Workers re-register through the
+        existing ``unknown_worker`` path and the survey finishes
+        byte-identical to an uninterrupted run.
+
+        A missing journal recovers nothing (re-add surveys: the ledger
+        makes that exact); a torn tail is truncated to a ``.corrupt``
+        backup; a version-mismatched journal is valid-but-rejected
+        (moved to ``.stale``).
+        """
+        coordinator = cls(output_dir, **kwargs)
+        coordinator._recover_from_journal()
+        return coordinator
+
+    def _recover_from_journal(self):
+        records = self.journal.replay()
+        done_cache = {}
+        requeued = 0
+        with self._lock:
+            for rec in records:
+                kind = rec.get("kind")
+                if kind == "file":
+                    fname = rec.get("fname")
+                    if not fname:
+                        continue
+                    self._files[fname] = {
+                        k: rec.get(k) for k in (
+                            "fingerprint", "config", "root", "workload",
+                            "artifact", "chunks_total", "chunk_starts",
+                            "chunk_est_bytes")}
+                elif kind == "unit":
+                    uid = rec.get("unit")
+                    if not uid or rec.get("fname") not in self._files:
+                        continue
+                    unit = _Unit(uid, rec["fname"],
+                                 rec.get("chunks") or ())
+                    unit.attempts = int(rec.get("attempts", 0))
+                    unit.epoch = int(rec.get("epoch", 1))
+                    if rec.get("trace_id"):
+                        unit.trace_id = str(rec["trace_id"])
+                    self._units[uid] = unit
+                    self._pending.append(uid)
+                    self._bump_seq_locked("unit", uid, "u")
+                elif kind == "grant":
+                    unit = self._units.get(rec.get("unit"))
+                    if unit is not None:
+                        unit.state = "leased"
+                        unit.epoch = max(unit.epoch,
+                                         int(rec.get("epoch", 1)))
+                        if unit.id in self._pending:
+                            self._pending.remove(unit.id)
+                    self._bump_seq_locked("lease", rec.get("lease"), "L")
+                elif kind == "requeue":
+                    unit = self._units.get(rec.get("unit"))
+                    if unit is None:
+                        continue
+                    unit.attempts = int(rec.get("attempts",
+                                                unit.attempts))
+                    unit.epoch = max(unit.epoch,
+                                     int(rec.get("epoch", unit.epoch)))
+                    unit.state = "pending"
+                    if unit.id not in self._pending:
+                        self._pending.insert(0, unit.id)
+                elif kind == "failed":
+                    unit = self._units.get(rec.get("unit"))
+                    if unit is None:
+                        continue
+                    unit.state = "failed"
+                    unit.attempts = int(rec.get("attempts",
+                                                unit.attempts))
+                    if unit.id in self._pending:
+                        self._pending.remove(unit.id)
+                    self._stats["failed"] += 1
+                elif kind == "duplicate":
+                    self._stats["duplicates"] += 1
+                elif kind == "stale":
+                    self._stats["stale_epochs"] += 1
+            # resolve every replayed unit against the LEDGERS: journal
+            # state is control-plane intent, the per-file ledger is the
+            # completion record — chunks another session finished are
+            # dropped here, exactly as at grant time
+            for unit in list(self._units.values()):
+                if unit.state == "failed":
+                    continue
+                remaining = self._ledger_remaining(unit, done_cache)
+                if not remaining:
+                    if unit.id in self._pending:
+                        self._pending.remove(unit.id)
+                    self._finish_unit_locked(unit)
+                    continue
+                unit.chunks = remaining
+                if unit.state == "leased":
+                    # in flight when the coordinator died: the lease
+                    # died with it — steal it now.  The epoch bump is
+                    # what fences a zombie still computing on the
+                    # pre-crash grant; no attempt burns (the crash was
+                    # the coordinator's fault, not the chunk's).
+                    unit.epoch += 1
+                    unit.state = "pending"
+                    if unit.id not in self._pending:
+                        self._pending.insert(0, unit.id)
+                    self._stats["requeued"] += 1
+                    _metrics.counter(
+                        "putpu_fleet_units_requeued_total").inc()
+                    self.journal.append(
+                        "requeue", unit=unit.id, attempts=unit.attempts,
+                        epoch=unit.epoch, why="coordinator recovery")
+                    requeued += 1
+            self._update_gauges_locked()
+            if records:
+                self.journal.append("recovered", files=len(self._files),
+                                    units=len(self._units),
+                                    pending=len(self._pending),
+                                    requeued=requeued)
+                _metrics.counter("putpu_fleet_recoveries_total").inc()
+        logger.info(
+            "fleet: recovered from journal — %d record(s) replayed, %d "
+            "file(s), %d unit(s) (%d pending, %d re-stolen from dead "
+            "leases)", len(records), len(self._files), len(self._units),
+            len(self._pending), requeued)
+        return len(records)
+
+    def _bump_seq_locked(self, key, ident, prefix):
+        """Keep ``_seq[key]`` above every journaled id so recovered
+        coordinators never re-mint a pre-crash unit/lease id."""
+        if not isinstance(ident, str) or not ident.startswith(prefix):
+            return
+        digits = ident[len(prefix):]
+        if digits.isdigit():
+            self._seq[key] = max(self._seq[key], int(digits))
 
     # -- the ledger: the only completion record ------------------------------
 
@@ -453,8 +626,12 @@ class FleetCoordinator:
         with self._lock:
             worker = self._workers.get(worker_id)
             if worker is None:
-                raise ValueError(f"unknown worker {worker_id!r} — "
-                                 "register first")
+                # structured code (ISSUE 15 satellite): the worker's
+                # re-registration trigger branches on this, not on the
+                # message text
+                raise protocol.ProtocolError(
+                    f"unknown worker {worker_id!r} — register first",
+                    code="unknown_worker")
             worker.last_seen = time.time()
             # a lease request IS liveness: a worker the prober declared
             # dead but which is demonstrably talking gets revived (its
@@ -532,8 +709,17 @@ class FleetCoordinator:
         # would ping-pong through O(chunks x attempts) descendants
         # instead of failing bounded (code-review r16)
         new.attempts = unit.attempts
+        # the tail also inherits the epoch: its chunks were (or may
+        # have been) granted under the parent's token, so a zombie
+        # holding the parent lease must stay fenceable against the
+        # tail's next grant too
+        new.epoch = unit.epoch
         self._units[new.id] = new
         self._pending.insert(0, new.id)
+        self.journal.append("unit", unit=new.id, fname=new.fname,
+                            chunks=list(new.chunks),
+                            attempts=new.attempts, epoch=new.epoch,
+                            trace_id=new.trace_id)
         _metrics.counter("putpu_fleet_units_resharded_total").inc()
         logger.info("fleet: unit %s re-sharded -> %s (%d chunks) + %s "
                     "(%d chunks): %s", unit.id, unit.id,
@@ -589,12 +775,21 @@ class FleetCoordinator:
             busy.setdefault(unit.fname, worker.id)
             self._stats["granted"] += 1
             _metrics.counter("putpu_fleet_leases_granted_total").inc()
+            # journal the grant (ISSUE 15): a restarted coordinator
+            # must know this unit was in flight (requeue + epoch bump)
+            # and must never re-mint this lease id
+            self.journal.append("grant", lease=lease.id, unit=unit.id,
+                                worker=worker.id, epoch=unit.epoch)
             rec = self._files[unit.fname]
             granted.append({
                 "lease": lease.id, "unit": unit.id, "fname": unit.fname,
                 "chunks": list(unit.chunks), "config": rec["config"],
                 "output_dir": self.output_dir,
                 "expires_in_s": self.lease_ttl_s,
+                # the fencing token (ISSUE 15): the worker passes it as
+                # the CandidateStore fence and echoes it in complete/
+                # release, so stale post-steal writes are rejectable
+                "epoch": unit.epoch,
                 # distributed-trace stamp (ISSUE 14): the worker binds
                 # this so its chunk/dispatch/persist spans share the
                 # unit's trace_id; old workers simply ignore the key
@@ -657,6 +852,43 @@ class FleetCoordinator:
             unit = self._units.get(unit_id)
             if unit is None:
                 raise ValueError(f"unknown unit {unit_id!r}")
+            epoch = doc.get("epoch")
+            if isinstance(epoch, (int, float)) and int(epoch) < unit.epoch:
+                # stale fencing token (ISSUE 15): this report belongs
+                # to a grant that was since stolen/requeued (possibly
+                # across a coordinator restart — the journal preserves
+                # epochs).  Rejected IDEMPOTENTLY: counted, journaled,
+                # never fatal, and crucially it must NOT resolve or
+                # requeue anything — the current epoch's holder owns
+                # the unit, and the ledger remains the only completion
+                # record either way.
+                self._stats["stale_epochs"] += 1
+                _metrics.counter(
+                    "putpu_fleet_stale_epoch_rejected_total").inc()
+                self.journal.append("stale", unit=unit_id,
+                                    worker=worker_id,
+                                    epoch=int(epoch),
+                                    current=unit.epoch)
+                logger.info(
+                    "fleet: stale-epoch completion of %s by %s rejected "
+                    "(epoch %d < current %d)", unit_id, worker_id,
+                    int(epoch), unit.epoch)
+                # the LEDGER may still resolve the unit (it is truth no
+                # matter who prompted the read): a zombie that finished
+                # the survey's last unit must not leave it pending
+                # forever just because its report was stale
+                if unit.state not in _TERMINAL \
+                        and unit.id not in {le.unit_id for le in
+                                            self._leases.values()} \
+                        and not self._ledger_remaining(unit, done_cache):
+                    if unit.id in self._pending:
+                        self._pending.remove(unit.id)
+                    self._finish_unit_locked(unit)
+                    self._update_gauges_locked()
+                return {"ok": True, "stale": True,
+                        "unit_done": unit.state == "done",
+                        "requeued": [],
+                        "survey_done": self._survey_done_locked()}
             lease = self._leases.get(lease_id)
             if lease is not None and lease.unit_id == unit_id:
                 del self._leases[lease_id]
@@ -669,6 +901,8 @@ class FleetCoordinator:
                 self._stats["duplicates"] += 1
                 _metrics.counter(
                     "putpu_fleet_duplicate_completions_total").inc()
+                self.journal.append("duplicate", unit=unit_id,
+                                    worker=worker_id, lease=lease_id)
                 logger.info(
                     "fleet: duplicate completion of %s by %s (lease %s "
                     "already resolved)", unit_id, worker_id, lease_id)
@@ -722,6 +956,12 @@ class FleetCoordinator:
         worker_id = str(protocol.require(doc, "worker", str, "release"))
         lease_ids = protocol.require(doc, "leases", list, "release")
         reason = str(doc.get("reason", "drain"))
+        # optional per-lease fencing tokens (ISSUE 15): a release of a
+        # lease that no longer exists — the zombie side of a steal — is
+        # rejected idempotently and counted, exactly like a stale
+        # complete.  Absent (old workers), unknown leases stay silent.
+        epochs = doc.get("epochs") if isinstance(doc.get("epochs"),
+                                                 dict) else None
         too_large = reason == "too_large"
         done_cache = {}
         requeued = 0
@@ -733,7 +973,20 @@ class FleetCoordinator:
                     worker.draining = True
             for lease_id in lease_ids:
                 lease = self._leases.pop(str(lease_id), None)
-                if lease is None or lease.worker_id != worker_id:
+                if lease is not None and lease.worker_id != worker_id:
+                    # not this worker's lease to return — put it back
+                    self._leases[lease.id] = lease
+                    continue
+                if lease is None:
+                    if epochs is not None and str(lease_id) in epochs:
+                        self._stats["stale_epochs"] += 1
+                        _metrics.counter(
+                            "putpu_fleet_stale_epoch_rejected_total"
+                        ).inc()
+                        self.journal.append(
+                            "stale", worker=worker_id,
+                            lease=str(lease_id),
+                            epoch=epochs[str(lease_id)])
                     continue
                 self._end_lease_span_locked(lease, f"released:{reason}")
                 unit = self._units[lease.unit_id]
@@ -783,12 +1036,20 @@ class FleetCoordinator:
         unit.chunks = remaining
         if count_attempt:
             unit.attempts += 1
+        # every requeue — steal, expiry, error, release — bumps the
+        # fencing epoch (ISSUE 15): whoever held the old grant is now
+        # provably stale, and the journal record makes the bump survive
+        # a coordinator crash (a recovered coordinator must never hand
+        # out an epoch a zombie still holds)
+        unit.epoch += 1
         if unit.attempts >= self.max_attempts:
             unit.state = "failed"
             if unit.id in self._pending:
                 self._pending.remove(unit.id)
             self._stats["failed"] += 1
             _metrics.counter("putpu_fleet_units_failed_total").inc()
+            self.journal.append("failed", unit=unit.id,
+                                attempts=unit.attempts, why=str(why))
             logger.error(
                 "fleet: unit %s (%s chunks %s) FAILED after %d attempts "
                 "(%s) — chunks stay unsearched, see /fleet/progress",
@@ -800,9 +1061,12 @@ class FleetCoordinator:
             self._pending.insert(0, unit.id)
         self._stats["requeued"] += 1
         _metrics.counter("putpu_fleet_units_requeued_total").inc()
+        self.journal.append("requeue", unit=unit.id,
+                            attempts=unit.attempts, epoch=unit.epoch,
+                            why=str(why))
         logger.warning("fleet: requeued unit %s chunks %s (%s, attempt "
-                       "%d/%d)", unit.id, list(remaining), why,
-                       unit.attempts, self.max_attempts)
+                       "%d/%d, epoch %d)", unit.id, list(remaining), why,
+                       unit.attempts, self.max_attempts, unit.epoch)
         return remaining
 
     def _survey_done_locked(self):
@@ -1080,6 +1344,7 @@ class FleetCoordinator:
             self._closed = True
         if self._sweeper is not None:
             self._sweeper.join(timeout=self.probe_interval_s + 5.0)
+        self.journal.close()
 
     def __enter__(self):
         return self

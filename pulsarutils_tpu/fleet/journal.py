@@ -1,0 +1,177 @@
+"""The coordinator's write-ahead journal: crash-and-restart as a non-event.
+
+PRs 4/9/11 hardened the *workers* end to end, but the coordinator's
+survey definitions, unit plans, per-unit attempt counts and failure
+records lived only in memory — a SIGKILLed coordinator was a
+manual-recovery incident.  :class:`FleetJournal` fixes that with the
+smallest durable thing that works: an append-only
+``fleet_journal.jsonl`` beside the per-file ledgers, one JSON record
+per *control-plane* event, flushed per append
+(:func:`~pulsarutils_tpu.io.atomic.append_jsonl` — a SIGKILL loses
+nothing already appended).
+
+What is journaled — and, deliberately, what is not:
+
+========== ===========================================================
+kind       meaning
+========== ===========================================================
+header     first record: ``{"schema_version": ...}`` (the tune-cache
+           rule: a valid file from another release is *rejected*, not
+           treated as corruption — backed up to ``.stale`` and the
+           coordinator starts a fresh journal + surveys re-added)
+file       one sharded file: fname, fingerprint, cleaned config,
+           workload, root, artifact, chunk grid, footprint estimate
+unit       one planned work unit: id, fname, chunks (re-shards append
+           new unit records carrying the inherited attempt count)
+grant      one lease grant: lease id, unit, worker, epoch — so a
+           restarted coordinator knows which units were in flight
+           (requeue them) and never re-mints a pre-crash lease id
+requeue    a unit went back to the queue: attempts + the BUMPED epoch
+           (the fencing token — every steal/requeue/reshard/recovery
+           moves it forward, so a zombie's stale epoch stays stale
+           across coordinator restarts)
+failed     a unit exhausted max_attempts
+duplicate  a late completion whose lease was already resolved
+stale      a completion/release carrying an out-of-date epoch
+recovered  a :meth:`~pulsarutils_tpu.fleet.coordinator.
+           FleetCoordinator.recover` replay completed
+========== ===========================================================
+
+Chunk *completion* is never journaled: the per-file exact-resume ledger
+stays the one authoritative completion record (re-read at every grant/
+complete/requeue), so the journal can be lost entirely and recovery
+degrades to "re-add the surveys; the ledger skips everything done" —
+no byte of science depends on it.
+
+Durability contract (the PR 4/7 rules): appends are single flushed
+lines; a torn tail (machine crash mid-append) is backed up to
+``.corrupt`` and truncated to the good prefix on replay
+(:func:`~pulsarutils_tpu.io.atomic.read_jsonl_tail_safe`); a
+schema-version mismatch is valid-but-rejected.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..io.atomic import JsonlAppender, read_jsonl_tail_safe
+from ..obs import metrics as _metrics
+from ..utils.logging_utils import logger
+
+__all__ = ["JOURNAL_NAME", "JOURNAL_SCHEMA_VERSION", "FleetJournal"]
+
+#: bump when a record's meaning changes (replay semantics, epoch rules)
+JOURNAL_SCHEMA_VERSION = 1
+
+#: the journal's fixed name beside the ledgers in ``output_dir``
+JOURNAL_NAME = "fleet_journal.jsonl"
+
+
+class FleetJournal:
+    """Append/replay the coordinator's control-plane event log.
+
+    ``path=None`` disables journaling entirely (``append`` no-ops,
+    ``replay`` returns nothing) — the byte-inert spelling for callers
+    that must not touch the output directory.
+    """
+
+    def __init__(self, path):
+        self.path = str(path) if path is not None else None
+        #: serialises the header check-then-append and the appender
+        #: handle (handler threads + the sweep loop all journal; two
+        #: racing first appends must not both write a header)
+        self._lock = threading.Lock()
+        #: one persistent append-mode handle — per-event re-opens
+        #: would serialize every protocol handler behind filesystem
+        #: open latency on the documented shared-filesystem deployment
+        self._appender = (JsonlAppender(self.path)
+                          if self.path is not None else None)
+        self._has_header = False
+        if self.path is not None and self._journal_nonempty():
+            # appending to an existing journal: the header (and its
+            # version fate) is replay's concern, not append's
+            self._has_header = True
+
+    def _journal_nonempty(self):
+        try:
+            return os.path.getsize(self.path) > 0
+        except OSError:
+            return False
+
+    @classmethod
+    def in_dir(cls, output_dir):
+        return cls(os.path.join(str(output_dir), JOURNAL_NAME))
+
+    def append(self, kind, **fields):
+        """Durably append one ``{"kind": kind, **fields}`` record."""
+        if self.path is None:
+            return
+        with self._lock:
+            if not self._has_header:
+                self._appender.append({
+                    "kind": "header",
+                    "schema_version": JOURNAL_SCHEMA_VERSION})
+                self._has_header = True
+            self._appender.append({"kind": str(kind), **fields})
+        _metrics.counter("putpu_fleet_journal_records_total").inc()
+
+    def close(self):
+        """Release the append handle (safe to call repeatedly; the
+        journal reopens lazily if appended to again)."""
+        with self._lock:
+            if self._appender is not None:
+                self._appender.reset()
+
+    def replay(self):
+        """The journal's replayable records, in append order.
+
+        Applies the full durability ladder: a missing journal replays
+        as empty (recovery falls back to the ledgers alone); a torn
+        tail is truncated to a ``.corrupt`` backup; a missing or
+        mismatched schema version rejects every record — the file is
+        moved aside to ``.stale`` (it is *valid*, just another
+        release's) and a fresh journal starts on the next append.
+        """
+        if self.path is None:
+            return []
+        with self._lock:
+            # the torn-tail truncation (and the .stale move below)
+            # REPLACE the file: a cached append handle would write to
+            # the old inode and every record after it would vanish
+            if self._appender is not None:
+                self._appender.reset()
+        records, _truncated = read_jsonl_tail_safe(self.path,
+                                                   what="fleet journal")
+        if not records:
+            # a missing journal, or one whose only (torn) line was
+            # truncated away: the next append must write a FRESH
+            # header — a stale _has_header=True here would leave the
+            # rest of the run headerless and make the NEXT recovery
+            # reject the whole (valid) journal as version-mismatched
+            with self._lock:
+                self._has_header = False
+            return []
+        header = records[0]
+        version = (header.get("schema_version")
+                   if isinstance(header, dict)
+                   and header.get("kind") == "header" else None)
+        if version != JOURNAL_SCHEMA_VERSION:
+            backup = self.path + ".stale"
+            try:
+                os.replace(self.path, backup)
+            except OSError:
+                backup = "<unmovable>"
+            logger.warning(
+                "fleet journal %s has schema version %r (expected %r): "
+                "records rejected, file moved to %s — re-add surveys, "
+                "the ledgers still skip everything done",
+                self.path, version, JOURNAL_SCHEMA_VERSION, backup)
+            with self._lock:
+                self._has_header = False
+            return []
+        out = [r for r in records[1:] if isinstance(r, dict)]
+        if out:
+            _metrics.counter(
+                "putpu_fleet_journal_replayed_total").inc(len(out))
+        return out

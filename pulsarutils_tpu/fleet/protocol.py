@@ -14,21 +14,36 @@ register   ``{"healthz_url": str|null, "worker": str|null,
 lease      ``{"worker": id, "max_units": n, "health": {verdict
            doc}|absent}`` -> ``{"leases": [{
            "lease", "unit", "fname", "chunks", "config",
-           "output_dir", "expires_in_s"}], "denied": str|null,
-           "survey_done": bool, "poll_s": float}``
+           "output_dir", "expires_in_s", "epoch"}], "denied":
+           str|null, "survey_done": bool, "poll_s": float}`` —
+           ``epoch`` is the unit's monotonic fencing token
+           (ISSUE 15): it bumps on every requeue/steal/reshard/
+           recovery, the worker passes it as the artifact fence and
+           echoes it back, so post-steal stragglers are detectably
+           stale
 complete   ``{"worker", "lease", "unit", "error": str|null,
+           "epoch": int|absent,
            "metrics": [registry snapshot], "health": {verdict doc}}``
            -> ``{"ok", "unit_done", "requeued": [chunks],
-           "survey_done"}``
-release    ``{"worker", "leases": [ids], "reason": str}`` ->
+           "survey_done"}`` — a stale ``epoch`` is rejected
+           idempotently: ``{"ok": true, "stale": true, ...}``,
+           counted, never fatal
+release    ``{"worker", "leases": [ids], "epochs": {id: epoch}|absent,
+           "reason": str}`` ->
            ``{"ok", "requeued": n}`` (graceful drain: unstarted
            leases go back to the queue, the worker gets no more —
            EXCEPT ``reason="too_large"`` (ISSUE 12), which does NOT
            drain the worker: the unit's preflight estimate exceeded
            its memory budget, so the coordinator re-shards the unit
            smaller instead of requeueing it verbatim onto the next
-           victim)
+           victim; a released lease the coordinator no longer holds
+           is stale-epoch counted when ``epochs`` names it)
 ========== ============================================================
+
+Protocol rejections are HTTP 400s whose JSON body carries the
+violation text and, where a machine decision hangs on it, a
+structured ``code`` (:class:`ProtocolError` — e.g. ``unknown_worker``
+drives worker re-registration after a coordinator restart).
 
 Design rules:
 
@@ -77,11 +92,30 @@ import urllib.error
 import urllib.request
 
 __all__ = ["PROTOCOL_VERSION", "SEARCH_KEYS", "TRACE_KEYS",
-           "TRANSIENT_WIRE_ERRORS", "clean_search_config",
-           "clean_trace_context", "get_json", "post_json",
-           "post_json_retry", "require"]
+           "TRANSIENT_WIRE_ERRORS", "ProtocolError",
+           "clean_search_config", "clean_trace_context", "get_json",
+           "post_json", "post_json_retry", "require"]
 
 PROTOCOL_VERSION = 1
+
+
+class ProtocolError(ValueError):
+    """A protocol-level rejection carrying a machine-readable ``code``.
+
+    ISSUE 15 satellite: the worker used to trigger re-registration by
+    matching the literal text ``"unknown worker"`` inside a 400 body —
+    a contract held together by a log message.  Handlers now raise
+    ``ProtocolError(msg, code="unknown_worker")``, the HTTP layer
+    serialises the code next to the message (``{"error": ..., "code":
+    ...}``), and :func:`post_json` re-attaches it on the client side so
+    callers branch on ``exc.code``.  Old coordinators' plain text still
+    matches as a fallback (back-compat both ways: an old worker simply
+    never reads the new field).
+    """
+
+    def __init__(self, message, code=None):
+        super().__init__(message)
+        self.code = code
 
 #: the trace-context fields a lease may carry (ISSUE 14) — the
 #: SEARCH_KEYS rule applied to tracing: the allowed set is written
@@ -197,8 +231,19 @@ def post_json(url, doc, timeout=10.0):
             return json.loads(resp.read().decode() or "{}")
     except urllib.error.HTTPError as exc:
         body = exc.read().decode(errors="replace")
-        raise ValueError(f"{url} -> HTTP {exc.code}: {body.strip()}") \
-            from exc
+        # surface the server's structured error code when the body
+        # carries one, so callers branch on exc.code instead of
+        # grepping the message text
+        code = None
+        try:
+            parsed = json.loads(body or "{}")
+            if isinstance(parsed, dict):
+                code = parsed.get("code")
+        except ValueError:
+            pass
+        raise ProtocolError(
+            f"{url} -> HTTP {exc.code}: {body.strip()}",
+            code=code) from exc
 
 
 def post_json_retry(url, doc, timeout=10.0, retries=3, backoff_s=0.2,
@@ -219,17 +264,42 @@ def post_json_retry(url, doc, timeout=10.0, retries=3, backoff_s=0.2,
     midpoint rule needs one request–response exchange, and a window
     inflated by failed attempts + backoff would corrupt the offset by
     half the retry time.
+
+    Partition chaos (ISSUE 15): every attempt first consults the
+    ``"wire"`` fault site (:func:`~pulsarutils_tpu.faults.inject.
+    wire_action`) — ``drop`` raises a synthetic transport error (the
+    message never reaches the coordinator, consuming a retry exactly
+    like a real partition), ``delay`` sleeps before sending, and
+    ``duplicate`` sends the message twice (a retransmit where both
+    copies land — the coordinator's idempotency contract under test).
+    Byte-inert with no plan armed, like every other hook.
     """
+    from ..faults import inject as fault_inject
     from ..obs import metrics as _metrics
 
+    msg = url.rstrip("/").rsplit("/", 1)[-1]
     last = None
     for attempt in range(max(int(retries), 0) + 1):
         try:
+            act = fault_inject.wire_action("wire", msg=msg)
+            if act is not None:
+                kind, seconds = act
+                if kind == "drop":
+                    raise urllib.error.URLError(
+                        f"FAULTPLAN: injected wire drop ({msg})")
+                if kind == "delay":
+                    time.sleep(seconds)
             t0 = time.time()
             out = post_json(url, doc, timeout=timeout)
+            t1 = time.time()
+            if act is not None and act[0] == "duplicate":
+                # the retransmit's reply is what the client keeps, but
+                # the timing window must bracket ONE exchange — the
+                # clock-offset midpoint rule's contract above
+                out = post_json(url, doc, timeout=timeout)
             if timing is not None:
                 timing["t0"] = t0
-                timing["t1"] = time.time()
+                timing["t1"] = t1
             return out
         except ValueError:
             raise  # HTTP status: the server answered; do not re-ask

@@ -49,7 +49,24 @@ from ..obs.server import start_obs_server
 from ..utils.logging_utils import logger
 from . import protocol
 
-__all__ = ["FleetWorker"]
+__all__ = ["FleetWorker", "needs_reregister"]
+
+
+def needs_reregister(exc):
+    """True when a lease failure means "the coordinator no longer knows
+    this worker" (its restart lost the in-memory worker table).
+
+    The contract is the structured wire code ``unknown_worker``
+    (:class:`~.protocol.ProtocolError`, ISSUE 15 satellite); the
+    literal-text match survives ONLY as the fallback for old
+    coordinators whose 400 bodies carry no ``code`` field — an
+    exception carrying any *other* code is a different protocol answer
+    and must not trigger re-registration however its message reads.
+    """
+    code = getattr(exc, "code", None)
+    if code is not None:
+        return code == "unknown_worker"
+    return "unknown worker" in str(exc)
 
 
 class FleetWorker:
@@ -343,13 +360,24 @@ class FleetWorker:
                        if sigma is not None else {}),
                     output_dir=lease["output_dir"], resume=True,
                     progress=False, health=self.engine,
-                    cancel_cb=self._drain.is_set, **kwargs)
+                    cancel_cb=self._drain.is_set,
+                    # the lease's fencing token covers the periodicity
+                    # candidates artifact too — a zombie finishing a
+                    # long trial sweep post-steal must not clobber the
+                    # new owner's npz (ISSUE 15)
+                    fence=lease.get("epoch"), **kwargs)
                 return None
             search_by_chunks(
                 lease["fname"], chunks=lease["chunks"],
                 output_dir=lease["output_dir"], resume=True,
                 make_plots=False, progress=False, health=self.engine,
-                cancel_cb=self._drain.is_set, **config)
+                cancel_cb=self._drain.is_set,
+                # the lease's fencing token (ISSUE 15): artifact writes
+                # stamped with a higher epoch — the new owner's, after
+                # this lease is stolen — are refused, so a partitioned
+                # zombie can never clobber live output.  Absent on an
+                # old coordinator: unfenced, the pre-epoch behaviour.
+                fence=lease.get("epoch"), **config)
             return None
         except Exception as exc:
             logger.error("fleet worker %s: unit %s failed (%r)",
@@ -360,6 +388,10 @@ class FleetWorker:
         doc = {
             "worker": self.worker_id, "lease": lease["lease"],
             "unit": lease["unit"], "error": error,
+            # echo the fencing token: a stale-epoch completion (this
+            # lease was stolen while we computed) is rejected
+            # idempotently on the coordinator — counted, never fatal
+            **({"epoch": lease["epoch"]} if "epoch" in lease else {}),
             # a drain-truncated unit says so: the coordinator requeues
             # the remainder WITHOUT burning the unit's max_attempts
             # budget (cooperative preemption is not a poison chunk)
@@ -399,6 +431,8 @@ class FleetWorker:
             self._post("/fleet/release", {
                 "worker": self.worker_id,
                 "leases": [le["lease"] for le in leases],
+                "epochs": {le["lease"]: le["epoch"] for le in leases
+                           if "epoch" in le},
                 "reason": reason})
         except (OSError, ValueError) as exc:
             # the coordinator is gone or rejecting: its lease TTL will
@@ -446,10 +480,10 @@ class FleetWorker:
                     # not trust its registration-time estimate forever
                     self._update_clock_offset(timing, resp)
                 except (OSError, ValueError) as exc:
-                    if "unknown worker" in str(exc):
-                        # the coordinator restarted and lost its worker
-                        # table: re-register (same live surface/port)
-                        # instead of spinning as a zombie forever
+                    # the coordinator restarted and lost its worker
+                    # table: re-register (same live surface/port)
+                    # instead of spinning as a zombie forever
+                    if needs_reregister(exc):
                         logger.warning(
                             "fleet worker %s: coordinator no longer "
                             "knows us (%r) — re-registering",

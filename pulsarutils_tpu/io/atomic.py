@@ -1,0 +1,174 @@
+"""THE sanctioned tmp+``os.replace`` persistence helper.
+
+Every durable ``*.json``/``*.jsonl`` state file in this tree — resume
+ledgers, the tune cache, the memory-budget calibration, zap lists, the
+fleet journal, the artifact fence map — lives or dies by the PR 4
+torn-write rules: a crash mid-write must leave the *previous* state
+intact, and a reader must survive whatever a crash still managed to
+tear.  Five PRs of copy-pasting ``tmp = path + ".tmp" ... os.replace``
+left the rule enforced by reviewer memory; this module is the rule,
+written down once, and the ``atomic-write`` checker of
+:mod:`pulsarutils_tpu.analysis` statically rejects direct
+``open(..., "w")`` persists of ``.json``/``.jsonl`` paths anywhere
+else.
+
+Two write shapes:
+
+* :func:`atomic_write_json` / :func:`atomic_write_text` — whole-document
+  rewrite via tmp + ``os.replace``: crash-safe, last-writer-wins;
+* :func:`append_jsonl` — one-record append for journals: each record is
+  a single ``write()`` + ``flush()`` of one line, so a SIGKILL can tear
+  at most the final line (the torn *tail*, which
+  :func:`read_jsonl_tail_safe` truncates on replay after backing the
+  torn file up).
+
+Keep this module stdlib-only: the analysis layer names it and the
+tuning/fleet layers import it on jax-free code paths.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+
+logger = logging.getLogger("pulsarutils_tpu")
+
+__all__ = ["JsonlAppender", "append_jsonl", "atomic_write_json",
+           "atomic_write_text", "read_jsonl_tail_safe"]
+
+
+def atomic_write_text(path, text):
+    """Write ``text`` to ``path`` atomically (tmp + ``os.replace``)."""
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)  # atomic: a crash keeps the old file
+
+
+def atomic_write_json(path, doc, *, indent=None, sort_keys=False,
+                      trailing_newline=False):
+    """Serialise ``doc`` and write it atomically.
+
+    The formatting knobs exist because several pre-helper writers'
+    on-disk bytes are pinned by tests and fleet byte-identity contracts
+    (the resume ledger is compact, the tune cache is
+    ``indent=1, sort_keys=True`` + newline) — centralising the atomic
+    rule must not move a byte of any of them.
+    """
+    text = json.dumps(doc, indent=indent, sort_keys=sort_keys)
+    if trailing_newline:
+        text += "\n"
+    atomic_write_text(path, text)
+
+
+def append_jsonl(path, record):
+    """Append ``record`` as one JSON line; returns the serialised line.
+
+    One ``write()`` of one ``\\n``-terminated line + ``flush()``: after
+    this returns, a SIGKILLed *process* loses nothing (the data is in
+    the page cache), and a machine crash can tear at most the last
+    line — exactly the torn tail :func:`read_jsonl_tail_safe` recovers
+    from.  Records must be single-line by construction (``json.dumps``
+    never emits a bare newline).
+    """
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    line = json.dumps(record) + "\n"
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(line)
+        f.flush()
+    return line
+
+
+class JsonlAppender:
+    """A persistent append-mode handle with the :func:`append_jsonl`
+    discipline — for journals on a hot path, where re-opening the file
+    per record (often under the caller's global lock, often on a
+    shared filesystem) would serialize every handler behind filesystem
+    open latency.  NOT thread-safe: the caller owns concurrency.
+
+    :meth:`reset` MUST be called after anything that replaces the file
+    behind the handle (a torn-tail truncation rewrite, a ``.stale``
+    move): a cached handle points at the *old inode* and its appends
+    would vanish.
+    """
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._fh = None
+
+    def append(self, record):
+        if self._fh is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    def reset(self):
+        """Drop the cached handle (reopened lazily on next append)."""
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    close = reset
+
+
+def read_jsonl_tail_safe(path, what="journal"):
+    """Parse a JSONL file, surviving a torn tail.
+
+    Returns ``(records, truncated)``.  Every parseable line from the
+    top is a record; the first unparseable (or unterminated) line and
+    everything after it is the torn tail of an interrupted append — the
+    whole torn file is backed up to ``<path>.corrupt`` and the good
+    prefix is rewritten in place (atomically), so the next append lands
+    on a clean file.  A missing file is simply ``([], False)``.
+    """
+    path = str(path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return [], False
+    records = []
+    good = []
+    truncated = False
+    for i, line in enumerate(raw.split("\n")):
+        if line == "" and i == raw.count("\n"):
+            break   # trailing empty split after the final newline
+        try:
+            records.append(json.loads(line))
+            good.append(line)
+        except ValueError:
+            truncated = True
+            break
+    # an unterminated final line is torn even if it happens to parse:
+    # the writer always terminates, so a missing newline means the
+    # append died mid-write and the line cannot be trusted complete
+    if not truncated and raw and not raw.endswith("\n") and good:
+        records.pop()
+        good.pop()
+        truncated = True
+    if truncated:
+        backup = path + ".corrupt"
+        try:
+            shutil.copy2(path, backup)
+        except OSError:
+            backup = "<uncopyable>"
+        atomic_write_text(path, "".join(g + "\n" for g in good))
+        logger.warning(
+            "torn %s tail in %s: backed up to %s, truncated to %d good "
+            "record(s)", what, path, backup, len(records))
+    return records, truncated

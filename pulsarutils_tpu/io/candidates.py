@@ -15,14 +15,18 @@ SURVEY §5).  This store makes both first-class:
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import logging
 import os
+import time
 
 from ..faults import inject as fault_inject
+from ..obs import metrics as _metrics
 from ..pipeline.pulse_info import PulseInfo
 from ..utils.table import ResultTable
+from .atomic import atomic_write_json
 
 logger = logging.getLogger("pulsarutils_tpu")
 
@@ -41,10 +45,27 @@ class CandidateStore:
     its own ledger file, so interleaved runs over different files/configs
     in one output directory never invalidate each other."""
 
-    def __init__(self, directory, fingerprint=None):
+    def __init__(self, directory, fingerprint=None, fence=None):
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.fingerprint = fingerprint
+        #: monotonic lease-epoch fencing token (ISSUE 15).  ``None``
+        #: (every single-process path) is byte-inert: no fence file is
+        #: ever read or written and the store behaves exactly as before.
+        #: Set (the fleet worker passes its lease's epoch), every
+        #: ``save_candidate`` consults ``fence_<fingerprint>.json`` and
+        #: REFUSES to clobber an artifact another session stamped with
+        #: a *higher* epoch — the defence the ledger's union merge
+        #: cannot give the ``.npz``/report artifacts: a partitioned
+        #: zombie whose lease was stolen keeps computing, and its late
+        #: writes must never overwrite the new owner's output.
+        self.fence = int(fence) if fence is not None else None
+        self._fence_path = (
+            os.path.join(self.directory, f"fence_{fingerprint}.json")
+            if self.fence is not None and fingerprint is not None
+            else None)
+        #: artifact writes this session refused under the fence
+        self.fenced_rejects = 0
         if fingerprint is None:
             self._ledger_path = None
             self._ledger = {"fingerprint": None, "done": []}
@@ -148,10 +169,7 @@ class CandidateStore:
                     k: q[k] for k in sorted(
                         q, key=lambda k: (0, int(k), "") if
                         str(k).lstrip("-").isdigit() else (1, 0, str(k)))}
-            tmp = self._ledger_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self._ledger, f)
-            os.replace(tmp, self._ledger_path)  # atomic: crash-safe resume
+            atomic_write_json(self._ledger_path, self._ledger)
             try:
                 st = os.stat(self._ledger_path)
                 self._last_write_stat = (st.st_size, st.st_mtime_ns)
@@ -221,9 +239,121 @@ class CandidateStore:
                        table: ResultTable):
         fault_inject.fire("persist", chunk=istart)
         base = self._base(root, istart, iend)
-        self.trim_waterfall(info, table).save(base + ".info.npz")
-        table.to_npz(base + ".table.npz")
+
+        def write():
+            self.trim_waterfall(info, table).save(base + ".info.npz")
+            table.to_npz(base + ".table.npz")
+
+        self.fenced_write(base, write)
         return base
+
+    # -- the artifact fence (ISSUE 15) ---------------------------------------
+
+    def fenced_write(self, path, write_fn):
+        """Run ``write_fn()`` (which writes the artifact at ``path``)
+        under the epoch fence; returns ``True`` when it ran.
+
+        Unfenced stores (``fence=None`` — every single-process path)
+        just run it.  Fenced stores take a cross-process lockfile
+        around check → write → stamp, so the steal edge's
+        admit-then-write window cannot interleave two writers: without
+        it, a zombie could pass the admit check before the new owner
+        stamps and land its bytes *after* — and two concurrent stamps
+        could lose the higher epoch (read-merge-write races).  The
+        re-search is deterministic, so even a lost race rewrites
+        identical bytes today; the lock keeps the fence a guarantee
+        rather than a bet on that property.
+        """
+        if self._fence_path is None:
+            write_fn()
+            return True
+        with self._fence_lock():
+            if not self._fence_admits(path):
+                return False
+            write_fn()
+            self._fence_stamp(path)
+        return True
+
+    @contextlib.contextmanager
+    def _fence_lock(self, timeout_s=30.0):
+        """Cross-process mutual exclusion for fenced writes: an
+        ``O_EXCL`` lockfile beside the fence map (the one primitive
+        that works on the fleet's shared filesystems).  A lock held
+        past ``timeout_s`` is presumed abandoned (its holder
+        SIGKILLed mid-write) and broken with a warning — availability
+        over the defence-in-depth, and contention only exists at the
+        steal edge at all."""
+        lock_path = self._fence_path + ".lock"
+        deadline = time.monotonic() + timeout_s
+        fd = None
+        while fd is None:
+            try:
+                fd = os.open(lock_path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                if time.monotonic() >= deadline:
+                    logger.warning(
+                        "breaking abandoned fence lock %s (held past "
+                        "%.0fs)", lock_path, timeout_s)
+                    try:
+                        os.unlink(lock_path)
+                    except OSError:
+                        pass
+                    deadline = time.monotonic() + timeout_s
+                else:
+                    time.sleep(0.05)
+        try:
+            yield
+        finally:
+            os.close(fd)
+            try:
+                os.unlink(lock_path)
+            except OSError:
+                pass
+
+    def _read_fence(self):
+        """``{artifact base name: epoch}`` off disk.  Unreadable/torn
+        state resolves to "nothing stamped" — the worst case is an
+        *allowed* write of idempotent bytes, never a lost artifact (the
+        same degrade-open rule as :meth:`_merge_from_disk`)."""
+        try:
+            with open(self._fence_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        epochs = doc.get("epochs") if isinstance(doc, dict) else None
+        if not isinstance(epochs, dict):
+            return {}
+        return {str(k): int(v) for k, v in epochs.items()
+                if isinstance(v, int)}
+
+    def _fence_admits(self, base):
+        """False when another session stamped ``base`` with a higher
+        epoch — this writer's lease was stolen and the new owner has
+        already written; clobbering it would let a zombie's stale
+        compute overwrite live output."""
+        name = os.path.basename(base)
+        stamped = self._read_fence().get(name)
+        if stamped is not None and stamped > self.fence:
+            self.fenced_rejects += 1
+            _metrics.counter("putpu_fleet_fenced_writes_total").inc()
+            logger.warning(
+                "fenced write rejected: %s is stamped epoch %d, this "
+                "session holds epoch %d (lease stolen; the new owner's "
+                "artifact stands)", name, stamped, self.fence)
+            return False
+        return True
+
+    def _fence_stamp(self, base):
+        """Record our epoch for ``base`` (read-merge-write keeping the
+        max per artifact; callers hold :meth:`_fence_lock`, so the
+        merge cannot lose a concurrent higher stamp)."""
+        name = os.path.basename(base)
+        epochs = self._read_fence()
+        epochs[name] = max(epochs.get(name, 0), self.fence)
+        atomic_write_json(self._fence_path,
+                          {"schema_version": 1,
+                           "epochs": dict(sorted(epochs.items()))})
 
     def trim_waterfall(self, info, table):
         """Bound the persisted record: full chunk in, pulse cutout out.

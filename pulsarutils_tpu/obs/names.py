@@ -120,6 +120,14 @@ METRIC_NAMES = {
     "putpu_fleet_duplicate_completions_total":
         "unit completions whose lease was already expired/revoked "
         "(the straggler side of a steal; resolved by the ledger)",
+    "putpu_fleet_fenced_writes_total":
+        "candidate artifact writes refused by the lease-epoch fence "
+        "(a stolen lease's zombie tried to clobber the new owner's "
+        "output)",
+    "putpu_fleet_journal_records_total":
+        "records appended to the coordinator write-ahead journal",
+    "putpu_fleet_journal_replayed_total":
+        "journal records replayed by FleetCoordinator.recover()",
     "putpu_fleet_leases_denied_total":
         "lease requests denied to DEGRADED/CRITICAL workers",
     "putpu_fleet_leases_expired_total":
@@ -128,6 +136,13 @@ METRIC_NAMES = {
         "work-unit leases granted to workers",
     "putpu_fleet_leases_revoked_total":
         "leases revoked from CRITICAL/dead workers (work-stealing)",
+    "putpu_fleet_recoveries_total":
+        "coordinator crash recoveries completed (journal replayed, "
+        "outstanding units re-derived from the ledgers)",
+    "putpu_fleet_stale_epoch_rejected_total":
+        "completes/releases carrying an out-of-date lease epoch, "
+        "rejected idempotently (the fenced side of a steal or a "
+        "coordinator restart)",
     "putpu_fleet_units_completed_total":
         "work units the per-file ledger confirms fully done",
     "putpu_fleet_units_failed_total":

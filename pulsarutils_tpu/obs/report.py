@@ -556,8 +556,11 @@ def _render_all(out_base, rec):
         f.write(render_html(rec))
     # the machine-readable record rides along: artifact parsers get
     # the sections as data, and :func:`amend_report` re-renders from it
-    with open(out_base + ".json", "w") as f:
-        json.dump(rec, f, indent=1)
+    # (atomically: amend_report re-reads this file, so a crash mid-write
+    # must leave the previous record intact)
+    from ..io.atomic import atomic_write_json
+
+    atomic_write_json(out_base + ".json", rec, indent=1)
     return md_path, html_path
 
 

@@ -193,8 +193,14 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             doc = fn(self._read_body())
         except ValueError as exc:
-            self._send(400, json.dumps({"error": str(exc)}),
-                       "application/json")
+            body = {"error": str(exc)}
+            # structured code (ISSUE 15): fleet.protocol.ProtocolError
+            # carries one (e.g. "unknown_worker"); the client re-attaches
+            # it so workers branch on codes, not 400-body text
+            code = getattr(exc, "code", None)
+            if code is not None:
+                body["code"] = str(code)
+            self._send(400, json.dumps(body), "application/json")
             return
         self._send(200, json.dumps(doc), "application/json")
 

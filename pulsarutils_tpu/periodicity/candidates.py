@@ -83,12 +83,11 @@ class ZapList:
         return len(self.entries)
 
     def save(self, path):
-        tmp = str(path) + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"version": _ZAP_VERSION, "zap": self.entries}, f,
-                      indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
+        from ..io.atomic import atomic_write_json
+
+        atomic_write_json(path,
+                          {"version": _ZAP_VERSION, "zap": self.entries},
+                          indent=1, sort_keys=True, trailing_newline=True)
 
     @classmethod
     def load(cls, path):

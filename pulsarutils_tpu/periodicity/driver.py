@@ -95,7 +95,7 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
                        snr_threshold=6.0, output_dir=None, resume=True,
                        canary=False, health=None, http_port=None,
                        report_out=None, cancel_cb=None, chunk_cb=None,
-                       progress=True, **search_kwargs):
+                       progress=True, fence=None, **search_kwargs):
     """Search one filterbank for (accelerated) pulsars at survey scale.
 
     Stages:
@@ -190,7 +190,8 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
                   plane_consumer=consumer, **search_kwargs)
     hits, store = search_by_chunks(fname, resume=resume, health=health,
                                    http_port=http_port,
-                                   cancel_cb=cancel_cb, **common)
+                                   cancel_cb=cancel_cb, fence=fence,
+                                   **common)
     if state["since_snap"] or not os.path.exists(snap_path):
         acc.save(snap_path)
         state["since_snap"] = 0
@@ -327,7 +328,19 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
             "canary": canary_info}
     cands_path = os.path.join(
         output_dir, f"period_cands_{sp['root']}_{sp['fingerprint']}.npz")
-    save_candidates(cands_path, kept, meta=meta)
+    # the candidates artifact gets the SAME epoch fence as the
+    # single-pulse npz (ISSUE 15): a periodicity unit is the whole
+    # observation, so a partitioned zombie finishing a long sweep
+    # after its lease was stolen is the likeliest clobber of all.
+    # store carries the lease's fence= (threaded through the
+    # accumulation transport above); fence-off runs write directly.
+    if not store.fenced_write(cands_path,
+                              lambda: save_candidates(cands_path, kept,
+                                                      meta=meta)):
+        logger.warning(
+            "periodicity candidates write fenced off: %s is stamped "
+            "with a higher lease epoch (this session's lease was "
+            "stolen; the new owner's artifact stands)", cands_path)
     _metrics.counter("putpu_period_jobs_total").inc()
 
     summary = {

@@ -411,7 +411,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      http_port=None, http_host="127.0.0.1", canary=None,
                      health=None, report_out=None, chunks=None,
                      cancel_cb=None, plane_consumer=None,
-                     fingerprint_extra=None):
+                     fingerprint_extra=None, fence=None):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -708,7 +708,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     snr_threshold = sp["snr_threshold"]
     search_snr_floor = sp["search_snr_floor"]
     fingerprint = sp["fingerprint"]
-    store = CandidateStore(output_dir, fingerprint if resume else None)
+    # fence (ISSUE 15): the fleet worker's lease epoch — candidate
+    # artifact writes stamped with a higher epoch are refused (see
+    # CandidateStore).  None (every non-fleet caller) is byte-inert.
+    store = CandidateStore(output_dir, fingerprint if resume else None,
+                           fence=fence)
     # quarantine manifest: created lazily on first record, so a clean
     # run's output directory is byte-identical to pre-hardening
     manifest = QuarantineManifest(output_dir,

@@ -289,15 +289,11 @@ def record_calibration(key, estimated, measured):
     offsets[str(key)] = round(value, 4)
     path = calibration_path()
     try:
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"version": _CALIB_VERSION, "offsets": offsets}, f,
-                      indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
+        from ..io.atomic import atomic_write_json
+
+        atomic_write_json(path,
+                          {"version": _CALIB_VERSION, "offsets": offsets},
+                          indent=1, sort_keys=True, trailing_newline=True)
     except OSError as exc:
         import logging
 

@@ -35,6 +35,8 @@ import os
 import threading
 import time
 
+from ..io.atomic import atomic_write_json
+
 logger = logging.getLogger("pulsarutils_tpu")
 
 #: bump when an entry's meaning changes (measurement discipline, key
@@ -147,14 +149,8 @@ class TuneCache:
     def _write_locked(self):
         doc = {"schema_version": TUNE_SCHEMA_VERSION,
                "entries": self._entries}
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, self.path)  # atomic: a crash keeps the old cache
+        atomic_write_json(self.path, doc, indent=1, sort_keys=True,
+                          trailing_newline=True)
 
     # -- entries -------------------------------------------------------------
 

@@ -568,7 +568,17 @@ def test_search_with_fallback_deadline_defaults_inline(monkeypatch):
 def test_chaos_drill_full_matrix():
     """The committed proof artifact, executed: every fault class in
     tools/chaos_drill.py passes its recoverable/unrecoverable
-    contract."""
+    contract.
+
+    The counts assert the REAL current matrix (the 9-vs-7 drift this
+    test carried since the oom/periodicity classes landed is fixed —
+    ISSUE 15 satellite), extended with the coordinator-crash/partition
+    classes: recoverable = 7 fault-plan classes (transient dispatch/
+    hang/persist/read, sanitizable NaN, dead channels, transient OOM)
+    + period_accumulation + torn_ledger + killed_coordinator +
+    partitioned_worker + torn_journal = 12; contained = oom_floor +
+    hard_corrupt + truncated_read + dead_letter = 4.
+    """
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -579,8 +589,12 @@ def test_chaos_drill_full_matrix():
     spec.loader.exec_module(drill)
     result = drill.run_drill(log=lambda *_: None)
     assert result["all_ok"], result["classes"]
-    assert result["recovered_identical"] == 7
-    assert result["contained"] == 3
+    assert result["n_classes"] == 16
+    assert result["recovered_identical"] == 12
+    assert result["contained"] == 4
+    for name in ("killed_coordinator", "partitioned_worker",
+                 "torn_journal"):
+        assert result["classes"][name]["ok"], result["classes"][name]
 
 
 def test_gate_skipped_for_lowbit_unpacked(tmp_path):

@@ -1,0 +1,620 @@
+"""Coordinator crash recovery, lease fencing, partition writes (ISSUE 15).
+
+Tier-1 pins: journal append/replay round-trip; torn-tail truncation to
+a ``.corrupt`` backup; version-mismatched journals valid-but-rejected;
+``FleetCoordinator.recover()`` rebuilding files/units/attempts/epochs
+with in-flight leases re-stolen under a bumped epoch; byte-identity of
+a SIGKILL-and-recover survey vs an uninterrupted run; replay from the
+ledgers alone when the journal is gone; stale-epoch completes/releases
+rejected idempotently; the ``CandidateStore`` epoch fence (byte-inert
+off, clobber-refusing on); the structured ``unknown_worker`` wire code
+with the old-coordinator text fallback; and ``"wire"`` partition
+faults (drop/delay/duplicate).
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+from pulsarutils_tpu.fleet import protocol
+from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+from pulsarutils_tpu.fleet.journal import (JOURNAL_NAME,
+                                           JOURNAL_SCHEMA_VERSION,
+                                           FleetJournal)
+from pulsarutils_tpu.fleet.worker import FleetWorker, needs_reregister
+from pulsarutils_tpu.io.candidates import CandidateStore
+from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+from pulsarutils_tpu.models.simulate import disperse_array
+from pulsarutils_tpu.obs import metrics as obs_metrics
+from pulsarutils_tpu.obs.server import start_obs_server
+from pulsarutils_tpu.pipeline.search_pipeline import (plan_survey,
+                                                      search_by_chunks)
+
+TSAMP = 0.0005
+NCHAN = 64
+NSAMPLES = 24576
+CONFIG = dict(dmmin=100, dmmax=200, chunk_length=8192 * TSAMP,
+              snr_threshold=6.5)
+
+
+def write_file(path, seed=0, pulse=False):
+    rng = np.random.default_rng(seed)
+    arr = np.abs(rng.normal(0, 0.5, (NCHAN, NSAMPLES))) + 20.0
+    if pulse:
+        arr[:, (3 * NSAMPLES) // 4] += 4.0
+        arr = disperse_array(arr, 150.0, 1200., 200., TSAMP)
+    header = {"bandwidth": 200., "fbottom": 1200., "nchans": NCHAN,
+              "nsamples": NSAMPLES, "tsamp": TSAMP,
+              "foff": 200. / NCHAN}
+    write_simulated_filterbank(str(path), arr, header, descending=True)
+    return str(path)
+
+
+def snapshot_dir(outdir):
+    """Ledger bytes + npz members (the chaos-drill comparison rule).
+    Fence/journal sidecars are deliberately NOT part of the science
+    byte-identity contract."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(str(outdir), "*"))):
+        name = os.path.basename(path)
+        if name.startswith("progress_") and name.endswith(".json"):
+            with open(path, "rb") as f:
+                out[name] = f.read()
+        elif name.endswith(".npz"):
+            with np.load(path, allow_pickle=False) as z:
+                out[name] = {k: (str(z[k].dtype), z[k].shape,
+                                 z[k].tobytes()) for k in z.files}
+    return out
+
+
+def mark_chunks_done(outdir, fingerprint, chunks):
+    store = CandidateStore(str(outdir), fingerprint)
+    for c in chunks:
+        store.mark_done(c)
+
+
+def counter_value(name):
+    return obs_metrics.counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# the journal itself
+# ---------------------------------------------------------------------------
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    journal = FleetJournal.in_dir(tmp_path)
+    journal.append("file", fname="/a.fil", fingerprint="f" * 16)
+    journal.append("unit", unit="u1", fname="/a.fil", chunks=[0, 8192])
+    records = FleetJournal.in_dir(tmp_path).replay()
+    assert [r["kind"] for r in records] == ["file", "unit"]
+    assert records[1]["chunks"] == [0, 8192]
+    # the header is versioned and not a replayable record
+    with open(journal.path) as f:
+        first = json.loads(f.readline())
+    assert first == {"kind": "header",
+                     "schema_version": JOURNAL_SCHEMA_VERSION}
+
+
+def test_journal_none_path_is_inert(tmp_path):
+    journal = FleetJournal(None)
+    journal.append("unit", unit="u1")
+    assert journal.replay() == []
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_torn_journal_tail_truncated_to_corrupt(tmp_path):
+    journal = FleetJournal.in_dir(tmp_path)
+    journal.append("unit", unit="u1", chunks=[0])
+    journal.append("unit", unit="u2", chunks=[8192])
+    with open(journal.path, "rb") as f:
+        blob = f.read()
+    # tear mid-way through the LAST record (a crash mid-append)
+    with open(journal.path, "wb") as f:
+        f.write(blob[: len(blob) - 9])
+    records = FleetJournal.in_dir(tmp_path).replay()
+    assert [r["unit"] for r in records] == ["u1"]
+    assert os.path.exists(journal.path + ".corrupt")
+    # the file was truncated to the good prefix: a fresh append lands
+    # on a clean journal and the next replay sees both
+    journal2 = FleetJournal.in_dir(tmp_path)
+    journal2.append("unit", unit="u3", chunks=[16384])
+    assert [r["unit"] for r in FleetJournal.in_dir(tmp_path).replay()] \
+        == ["u1", "u3"]
+
+
+def test_unterminated_final_line_is_torn(tmp_path):
+    journal = FleetJournal.in_dir(tmp_path)
+    journal.append("unit", unit="u1")
+    # a parseable but unterminated final line: the append died between
+    # write and the newline landing — it cannot be trusted complete
+    with open(journal.path, "a") as f:  # putpu-lint: disable=atomic-write — deliberately torn fixture
+        f.write(json.dumps({"kind": "unit", "unit": "u2"}))
+    assert [r["unit"] for r in FleetJournal.in_dir(tmp_path).replay()] \
+        == ["u1"]
+
+
+def test_version_mismatched_journal_rejected_not_corrupt(tmp_path):
+    path = os.path.join(str(tmp_path), JOURNAL_NAME)
+    with open(path, "w") as f:  # putpu-lint: disable=atomic-write — fixture forges an old-release journal
+        f.write(json.dumps({"kind": "header", "schema_version": 999})
+                + "\n")
+        f.write(json.dumps({"kind": "unit", "unit": "u1"}) + "\n")
+    journal = FleetJournal.in_dir(tmp_path)
+    assert journal.replay() == []
+    # valid-but-rejected: moved aside as .stale, NOT .corrupt
+    assert os.path.exists(path + ".stale")
+    assert not os.path.exists(path + ".corrupt")
+    # the next append starts a fresh journal at the current version
+    journal.append("unit", unit="u2")
+    records = FleetJournal.in_dir(tmp_path).replay()
+    assert [r["unit"] for r in records] == ["u2"]
+
+
+def test_torn_header_journal_recovers_cleanly(tmp_path):
+    """A journal whose ONLY line (the header) was torn mid-append must
+    not poison the next session: replay truncates to empty AND resets
+    the header state, so subsequent appends start a fresh versioned
+    journal instead of a headerless one the NEXT recovery would
+    reject wholesale as version-mismatched (code-review catch)."""
+    path = os.path.join(str(tmp_path), JOURNAL_NAME)
+    with open(path, "w") as f:  # putpu-lint: disable=atomic-write — deliberately torn fixture
+        f.write('{"kind": "header", "schema_ver')   # torn mid-header
+    journal = FleetJournal.in_dir(tmp_path)
+    assert journal.replay() == []
+    journal.append("unit", unit="u1")
+    records = FleetJournal.in_dir(tmp_path).replay()
+    assert [r["unit"] for r in records] == ["u1"]
+    # NOT rejected as another release's journal
+    assert not os.path.exists(path + ".stale")
+
+
+def test_journal_append_after_replay_truncation(tmp_path):
+    """replay()'s truncation rewrite replaces the file: the journal's
+    persistent append handle must re-open, not write to the dead
+    inode (records after a recovery would silently vanish)."""
+    journal = FleetJournal.in_dir(tmp_path)
+    journal.append("unit", unit="u1")
+    with open(journal.path, "rb+") as f:
+        f.seek(-5, os.SEEK_END)
+        f.truncate()                         # torn tail
+    assert [r["unit"] for r in journal.replay()] == []
+    journal.append("unit", unit="u2")        # same instance, post-replay
+    assert [r["unit"] for r in FleetJournal.in_dir(tmp_path).replay()] \
+        == ["u2"]
+
+
+# ---------------------------------------------------------------------------
+# coordinator recovery
+# ---------------------------------------------------------------------------
+
+def test_recover_replays_units_attempts_epochs_and_seqs(tmp_path):
+    fname = write_file(tmp_path / "a.fil", seed=20)
+    out = str(tmp_path / "fleet")
+    first = FleetCoordinator(out, auto_sweep=False, lease_ttl_s=5.0)
+    first.add_survey([fname], **CONFIG)
+    w = first.register({})["worker"]
+    lease = first.lease({"worker": w, "max_units": 1})["leases"][0]
+    assert lease["epoch"] == 1
+    # an error completion: attempt burns, epoch bumps
+    first.complete({"worker": w, "lease": lease["lease"],
+                    "unit": lease["unit"], "error": "boom",
+                    "epoch": lease["epoch"]})
+    # a second grant of the same unit stays in flight at the crash
+    lease2 = first.lease({"worker": w, "max_units": 1})["leases"][0]
+    assert lease2["unit"] == lease["unit"] and lease2["epoch"] == 2
+    # SIGKILL-equivalent: the object is dropped, nothing is flushed or
+    # closed beyond what the journal already persisted per event
+    del first
+
+    second = FleetCoordinator.recover(out, auto_sweep=False,
+                                      lease_ttl_s=5.0)
+    units = {u.id: u for u in second._units.values()}
+    victim = units[lease["unit"]]
+    assert victim.attempts == 1            # the error attempt survived
+    # in flight at the crash: re-stolen with a bumped epoch (2 -> 3),
+    # so the pre-crash grant's epoch is provably stale
+    assert victim.state == "pending" and victim.epoch == 3
+    # id sequences restored: new units/leases never collide with
+    # pre-crash ids
+    w2 = second.register({})["worker"]
+    regrant = second.lease({"worker": w2, "max_units": 1})["leases"][0]
+    assert regrant["lease"] != lease2["lease"]
+    assert regrant["epoch"] == 3
+    second.close()
+
+
+def test_recover_finishes_survey_byte_identical(tmp_path):
+    """The tentpole acceptance pin: SIGKILL the coordinator mid-survey
+    (one unit done, one leased in flight), recover(), finish — ledgers
+    and candidate artifacts byte-identical to an uninterrupted run."""
+    fname = write_file(tmp_path / "a.fil", seed=0, pulse=True)
+    search_by_chunks(fname, output_dir=str(tmp_path / "single"),
+                     make_plots=False, progress=False, **CONFIG)
+
+    out = str(tmp_path / "fleet")
+    before = counter_value("putpu_fleet_recoveries_total")
+    first = FleetCoordinator(out, auto_sweep=False, lease_ttl_s=60.0)
+    with start_obs_server(0, fleet=first) as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        first.add_survey([fname], **CONFIG)
+        worker = FleetWorker(url, http_port=None)
+        orig = worker._run_unit
+
+        def drain_after_first(lease):
+            result = orig(lease)
+            worker.drain()
+            return result
+
+        worker._run_unit = drain_after_first
+        worker.run()
+        assert worker.units_done == 1
+        # leave a lease in flight so the crash strands it
+        ghost = first.register({})["worker"]
+        stranded = first.lease({"worker": ghost,
+                                "max_units": 1})["leases"][0]
+    del first   # SIGKILL-equivalent: in-memory state gone
+
+    second = FleetCoordinator.recover(out, auto_sweep=False,
+                                      lease_ttl_s=60.0)
+    assert counter_value("putpu_fleet_recoveries_total") == before + 1
+    # the stranded unit came back pending with a bumped (fencing) epoch
+    unit = second._units[stranded["unit"]]
+    assert unit.state == "pending" and unit.epoch == stranded["epoch"] + 1
+    with start_obs_server(0, fleet=second) as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        finisher = FleetWorker(url, http_port=None)
+        finisher.run(max_idle_s=60.0)
+        assert second.survey_done
+    second.close()
+    assert snapshot_dir(tmp_path / "single") == snapshot_dir(out)
+
+
+def test_recover_without_journal_falls_back_to_ledgers(tmp_path):
+    """Journal gone entirely: recover() restores nothing, but re-adding
+    the survey replays completion from the per-file ledgers alone — the
+    ledger stays the one authoritative record."""
+    fname = write_file(tmp_path / "a.fil", seed=21, pulse=True)
+    search_by_chunks(fname, output_dir=str(tmp_path / "single"),
+                     make_plots=False, progress=False, **CONFIG)
+    out = str(tmp_path / "fleet")
+    fingerprint = plan_survey(fname, **CONFIG)["fingerprint"]
+    # one chunk already done on disk, then the journal is lost
+    search_by_chunks(fname, output_dir=out, make_plots=False,
+                     progress=False, max_chunks=1, **CONFIG)
+    journal_path = os.path.join(out, JOURNAL_NAME)
+    if os.path.exists(journal_path):
+        os.remove(journal_path)
+    second = FleetCoordinator.recover(out, auto_sweep=False)
+    assert second._units == {}             # nothing to replay
+    ids = second.add_survey([fname], **CONFIG)
+    assert len(ids) == 1                   # the ledger-done chunk skipped
+    with start_obs_server(0, fleet=second) as srv:
+        FleetWorker(f"http://127.0.0.1:{srv.port}",
+                    http_port=None).run(max_idle_s=60.0)
+        assert second.survey_done
+    second.close()
+    assert snapshot_dir(tmp_path / "single") == snapshot_dir(out)
+    assert fingerprint in "".join(snapshot_dir(out))
+
+
+# ---------------------------------------------------------------------------
+# lease epochs: stale rejection + the artifact fence
+# ---------------------------------------------------------------------------
+
+def test_stale_epoch_complete_rejected_idempotently(tmp_path):
+    fname = write_file(tmp_path / "a.fil", seed=22)
+    out = tmp_path / "fleet"
+    before = counter_value("putpu_fleet_stale_epoch_rejected_total")
+    with FleetCoordinator(str(out), auto_sweep=False,
+                          lease_ttl_s=5.0) as coordinator:
+        coordinator.add_survey([fname], **CONFIG)
+        fingerprint = coordinator.progress_doc()["files"][0]["fingerprint"]
+        w1 = coordinator.register({})["worker"]
+        w2 = coordinator.register({})["worker"]
+        lease1 = coordinator.lease({"worker": w1,
+                                    "max_units": 1})["leases"][0]
+        assert lease1["epoch"] == 1
+        # TTL expiry bumps the epoch; w2's grant carries the new token
+        coordinator.sweep(now=time.monotonic() + 10.0)
+        lease2 = coordinator.lease({"worker": w2,
+                                    "max_units": 1})["leases"][0]
+        assert lease2["unit"] == lease1["unit"]
+        assert lease2["epoch"] == 2
+        mark_chunks_done(out, fingerprint, lease2["chunks"])
+        done = coordinator.complete({"worker": w2, "lease": lease2["lease"],
+                                     "unit": lease2["unit"], "error": None,
+                                     "epoch": lease2["epoch"]})
+        assert done["unit_done"] is True and "stale" not in done
+        ledger = snapshot_dir(out)[f"progress_{fingerprint}.json"]
+        # the zombie's completion carries the stale token: counted,
+        # nothing resolved or requeued on its word, ledger untouched
+        late = coordinator.complete({"worker": w1, "lease": lease1["lease"],
+                                     "unit": lease1["unit"], "error": None,
+                                     "epoch": lease1["epoch"]})
+        assert late["stale"] is True
+        assert late["unit_done"] is True   # the ledger's verdict stands
+        assert late["requeued"] == []
+        assert counter_value("putpu_fleet_stale_epoch_rejected_total") \
+            == before + 1
+        assert coordinator.progress_doc()["stats"]["stale_epochs"] == 1
+        assert snapshot_dir(out)[f"progress_{fingerprint}.json"] == ledger
+
+
+def test_stale_epoch_release_counted_idempotently(tmp_path):
+    fname = write_file(tmp_path / "a.fil", seed=23)
+    before = counter_value("putpu_fleet_stale_epoch_rejected_total")
+    with FleetCoordinator(str(tmp_path / "fleet"), auto_sweep=False,
+                          lease_ttl_s=5.0) as coordinator:
+        coordinator.add_survey([fname], **CONFIG)
+        w1 = coordinator.register({})["worker"]
+        lease1 = coordinator.lease({"worker": w1,
+                                    "max_units": 1})["leases"][0]
+        coordinator.sweep(now=time.monotonic() + 10.0)   # stolen
+        pending_before = coordinator.progress_doc()["units"]
+        resp = coordinator.release({
+            "worker": w1, "leases": [lease1["lease"]],
+            "epochs": {lease1["lease"]: lease1["epoch"]},
+            "reason": "drain"})
+        assert resp["requeued"] == 0
+        assert counter_value("putpu_fleet_stale_epoch_rejected_total") \
+            == before + 1
+        assert coordinator.progress_doc()["units"] == pending_before
+
+
+def test_candidate_store_fence_rejects_lower_epoch(tmp_path):
+    from pulsarutils_tpu.pipeline.pulse_info import PulseInfo
+    from pulsarutils_tpu.utils.table import ResultTable
+
+    def make_payload(value):
+        info = PulseInfo(allprofs=np.full((4, 16), value, np.float32))
+        table = ResultTable({"DM": np.array([150.0]),
+                             "Sigma": np.array([9.0]),
+                             "peak": np.array([5])})
+        return info, table
+
+    before = counter_value("putpu_fleet_fenced_writes_total")
+    fp = "a" * 16
+    owner = CandidateStore(str(tmp_path), fp, fence=2)
+    owner.mark_done(0)
+    owner.save_candidate("s", 0, 16, *make_payload(2.0))
+    ref = snapshot_dir(tmp_path)
+    # the zombie (stolen lease, lower epoch) computes different bytes —
+    # the fence must refuse the clobber
+    zombie = CandidateStore(str(tmp_path), fp, fence=1)
+    base = zombie.save_candidate("s", 0, 16, *make_payload(1.0))
+    assert base.endswith("s_0-16")
+    assert zombie.fenced_rejects == 1
+    assert counter_value("putpu_fleet_fenced_writes_total") == before + 1
+    assert snapshot_dir(tmp_path) == ref   # owner's artifact stands
+    # a HIGHER epoch may overwrite (it is the newer owner)
+    newer = CandidateStore(str(tmp_path), fp, fence=3)
+    newer.save_candidate("s", 0, 16, *make_payload(3.0))
+    assert snapshot_dir(tmp_path) != ref
+    assert newer.fenced_rejects == 0
+    # the fence map recorded the max epoch
+    with open(os.path.join(str(tmp_path), f"fence_{fp}.json")) as f:
+        assert json.load(f)["epochs"]["s_0-16"] == 3
+
+
+def test_fence_unset_is_byte_inert(tmp_path):
+    """fence=None (every single-process path) must neither read nor
+    write any fence state — pinned so all pre-ISSUE-15 goldens hold."""
+    fname = write_file(tmp_path / "a.fil", seed=0, pulse=True)
+    search_by_chunks(fname, output_dir=str(tmp_path / "plain"),
+                     make_plots=False, progress=False, **CONFIG)
+    assert not glob.glob(os.path.join(str(tmp_path / "plain"),
+                                      "fence_*.json"))
+    # fenced run: identical science bytes, plus the fence sidecar
+    search_by_chunks(fname, output_dir=str(tmp_path / "fenced"),
+                     make_plots=False, progress=False, fence=1, **CONFIG)
+    assert snapshot_dir(tmp_path / "plain") \
+        == snapshot_dir(tmp_path / "fenced")
+    assert glob.glob(os.path.join(str(tmp_path / "fenced"),
+                                  "fence_*.json"))
+
+
+def test_partitioned_zombie_fenced_end_to_end(tmp_path):
+    """The partition drill in miniature, over the real wire: a zombie
+    worker hangs mid-dispatch past its lease TTL, the unit is stolen
+    and finished at a bumped epoch, the zombie wakes, its late
+    artifact writes are fenced and its completion is stale — and the
+    survey output is byte-identical to the single-process run."""
+    fname = write_file(tmp_path / "a.fil", seed=0, pulse=True)
+    search_by_chunks(fname, output_dir=str(tmp_path / "single"),
+                     make_plots=False, progress=False, **CONFIG)
+    hit_chunk = 8192
+    out = str(tmp_path / "fleet")
+    stale_before = counter_value("putpu_fleet_stale_epoch_rejected_total")
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="hang",
+                                seconds=8.0, chunks=(hit_chunk,),
+                                times=1)])
+    coordinator = FleetCoordinator(out, lease_ttl_s=2.0,
+                                   probe_interval_s=0.25)
+    srv = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{srv.port}"
+    coordinator.add_survey([fname], **CONFIG)
+    try:
+        with plan.armed():
+            zombie = FleetWorker(url, http_port=None, max_units=1)
+            zt = threading.Thread(target=zombie.run,
+                                  kwargs={"max_idle_s": 60.0})
+            zt.start()
+            # wait for the steal: the zombie is hung inside the hit
+            # chunk's dispatch, its lease TTL passes, the sweep requeues
+            deadline = time.time() + 60.0
+            while time.time() < deadline and \
+                    coordinator.progress_doc()["stats"]["expired"] < 1:
+                time.sleep(0.1)
+            assert coordinator.progress_doc()["stats"]["expired"] >= 1
+            rescuer = FleetWorker(url, http_port=None)
+            rescuer.run(max_idle_s=30.0)
+            zt.join(timeout=120.0)
+            assert not zt.is_alive()
+        assert coordinator.survey_done
+        stats = coordinator.progress_doc()["stats"]
+    finally:
+        srv.close()
+        coordinator.close()
+    # the zombie's post-steal report carried the stale epoch
+    assert counter_value("putpu_fleet_stale_epoch_rejected_total") \
+        > stale_before
+    assert stats["stale_epochs"] >= 1
+    # and the science output is exactly the single-process run's
+    assert snapshot_dir(tmp_path / "single") == snapshot_dir(out)
+
+
+# ---------------------------------------------------------------------------
+# structured error code + wire partition faults
+# ---------------------------------------------------------------------------
+
+def test_unknown_worker_carries_structured_code(tmp_path):
+    write_file(tmp_path / "a.fil", seed=24)
+    with FleetCoordinator(str(tmp_path / "fleet"),
+                          auto_sweep=False) as coordinator:
+        with start_obs_server(0, fleet=coordinator) as srv:
+            with pytest.raises(ValueError) as err:
+                protocol.post_json(
+                    f"http://127.0.0.1:{srv.port}/fleet/lease",
+                    {"worker": "ghost"})
+            assert err.value.code == "unknown_worker"
+            assert "unknown worker" in str(err.value)
+
+
+def test_needs_reregister_code_and_text_fallback():
+    # the structured contract: the code decides, whatever the text says
+    assert needs_reregister(
+        protocol.ProtocolError("anything at all", code="unknown_worker"))
+    assert not needs_reregister(
+        protocol.ProtocolError("unknown worker 'w1'", code="bad_request"))
+    # old-coordinator fallback: no code field, the literal text matches
+    assert needs_reregister(ValueError("HTTP 400: unknown worker 'w1'"))
+    assert not needs_reregister(ValueError("HTTP 400: malformed lease"))
+
+
+def test_wire_drop_consumes_retries_then_lands(tmp_path):
+    write_file(tmp_path / "a.fil", seed=25)
+    before = counter_value("putpu_fleet_wire_retries_total")
+    with FleetCoordinator(str(tmp_path / "fleet"),
+                          auto_sweep=False) as coordinator:
+        with start_obs_server(0, fleet=coordinator) as srv:
+            url = f"http://127.0.0.1:{srv.port}"
+            plan = FaultPlan([FaultSpec(site="wire", kind="drop",
+                                        msg="register", times=2)])
+            with plan.armed():
+                doc = protocol.post_json_retry(
+                    url + "/fleet/register", {"healthz_url": None},
+                    retries=3, backoff_s=0.01, jitter_s=0.0)
+            assert doc["worker"]
+            assert plan.fired() == 2
+            assert counter_value("putpu_fleet_wire_retries_total") \
+                == before + 2
+            # a drop past the retry budget surfaces as the transport
+            # error a real partition would
+            full = FaultPlan([FaultSpec(site="wire", kind="drop",
+                                        times=None)])
+            with full.armed(), pytest.raises(OSError):
+                protocol.post_json_retry(
+                    url + "/fleet/register", {"healthz_url": None},
+                    retries=1, backoff_s=0.01, jitter_s=0.0)
+
+
+def test_wire_duplicate_complete_is_idempotent(tmp_path):
+    """A duplicated complete message (retransmit where both copies
+    land) resolves once and counts one duplicate — the coordinator's
+    idempotency contract under partition chaos."""
+    fname = write_file(tmp_path / "a.fil", seed=26)
+    out = tmp_path / "fleet"
+    before = counter_value("putpu_fleet_duplicate_completions_total")
+    with FleetCoordinator(str(out), auto_sweep=False) as coordinator:
+        with start_obs_server(0, fleet=coordinator) as srv:
+            url = f"http://127.0.0.1:{srv.port}"
+            coordinator.add_survey([fname], **CONFIG)
+            fingerprint = coordinator.progress_doc()["files"][0][
+                "fingerprint"]
+            w = coordinator.register({})["worker"]
+            lease = coordinator.lease({"worker": w,
+                                       "max_units": 1})["leases"][0]
+            mark_chunks_done(out, fingerprint, lease["chunks"])
+            plan = FaultPlan([FaultSpec(site="wire", kind="duplicate",
+                                        msg="complete", times=1)])
+            with plan.armed():
+                resp = protocol.post_json_retry(
+                    url + "/fleet/complete",
+                    {"worker": w, "lease": lease["lease"],
+                     "unit": lease["unit"], "error": None,
+                     "epoch": lease["epoch"]})
+            assert plan.fired() == 1
+            assert resp["unit_done"] is True
+            # resolved exactly once; the retransmit counted as the
+            # straggler duplicate and changed nothing
+            assert counter_value(
+                "putpu_fleet_duplicate_completions_total") == before + 1
+
+
+def test_wire_duplicate_timing_brackets_one_exchange(monkeypatch):
+    """A duplicated message must not inflate the clock-offset timing
+    window: ``timing`` brackets the FIRST exchange only — the midpoint
+    rule's contract (code-review catch)."""
+    calls = []
+
+    def fake_post(url, doc, timeout=10.0):
+        calls.append(time.time())
+        time.sleep(0.15)
+        return {"ok": True}
+
+    monkeypatch.setattr(protocol, "post_json", fake_post)
+    plan = FaultPlan([FaultSpec(site="wire", kind="duplicate",
+                                times=1)])
+    timing = {}
+    with plan.armed():
+        protocol.post_json_retry("http://x/fleet/lease", {},
+                                 timing=timing)
+    assert len(calls) == 2                   # the retransmit landed
+    # t1 was stamped before the second post started
+    assert timing["t1"] <= calls[1]
+    assert timing["t1"] - timing["t0"] < 0.3
+
+
+def test_fenced_write_guards_arbitrary_artifacts(tmp_path):
+    """The public fenced_write seam (the periodicity candidates npz
+    rides it): lower epochs are refused, the winner's bytes stand,
+    and the cross-process lockfile is cleaned up."""
+    fp = "b" * 16
+    target = os.path.join(str(tmp_path), f"period_cands_s_{fp}.npz")
+    owner = CandidateStore(str(tmp_path), fp, fence=2)
+    assert owner.fenced_write(
+        target, lambda: np.savez(target, x=np.array([2.0]))) is True
+    zombie = CandidateStore(str(tmp_path), fp, fence=1)
+    assert zombie.fenced_write(
+        target, lambda: np.savez(target, x=np.array([1.0]))) is False
+    with np.load(target) as z:
+        assert z["x"][0] == 2.0              # the owner's artifact stands
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), f"fence_{fp}.json.lock"))
+    # unfenced stores just write (byte-inert contract)
+    plain = CandidateStore(str(tmp_path / "plain"), fp)
+    other = os.path.join(str(tmp_path / "plain"), "x.npz")
+    assert plain.fenced_write(
+        other, lambda: np.savez(other, x=np.array([0.0]))) is True
+
+
+def test_wire_delay_just_delays(tmp_path):
+    write_file(tmp_path / "a.fil", seed=27)
+    with FleetCoordinator(str(tmp_path / "fleet"),
+                          auto_sweep=False) as coordinator:
+        with start_obs_server(0, fleet=coordinator) as srv:
+            url = f"http://127.0.0.1:{srv.port}"
+            plan = FaultPlan([FaultSpec(site="wire", kind="delay",
+                                        seconds=0.4, msg="register",
+                                        times=1)])
+            t0 = time.time()
+            with plan.armed():
+                doc = protocol.post_json_retry(url + "/fleet/register",
+                                               {"healthz_url": None})
+            assert doc["worker"] and time.time() - t0 >= 0.4

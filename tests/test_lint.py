@@ -641,6 +641,84 @@ def test_fingerprint_helper_matches_batch():
     assert fp == batch[id(f)]
 
 
+# -- checker: atomic-write (ISSUE 15) ----------------------------------------
+
+def test_atomic_write_constant_json_path_fires():
+    src = """\
+    import json
+    def persist(doc):
+        with open("state/progress.json", "w") as f:
+            json.dump(doc, f)
+    """
+    assert ids(lint(src, path="pulsarutils_tpu/io/fixture.py")) \
+        == ["atomic-write"]
+
+
+def test_atomic_write_fstring_and_concat_suffixes_fire():
+    src = """\
+    def persist(fp, doc, path):
+        with open(f"progress_{fp}.json", "w") as f:
+            f.write(doc)
+        with open(path + ".jsonl", "a") as f:
+            f.write(doc)
+    """
+    assert ids(lint(src, path="pulsarutils_tpu/fleet/fixture.py")) \
+        == ["atomic-write", "atomic-write"]
+
+
+def test_atomic_write_join_tail_fires():
+    src = """\
+    import os
+    def persist(outdir, doc):
+        with open(os.path.join(outdir, "fleet_journal.jsonl"),
+                  "a") as f:
+            f.write(doc)
+    """
+    assert ids(lint(src, path="pulsarutils_tpu/fleet/fixture.py")) \
+        == ["atomic-write"]
+
+
+def test_atomic_write_reads_and_tmp_and_variables_are_silent():
+    # reads, the helper's own .tmp half of the pattern, and
+    # operator-named variable paths (--out artifacts) are all fine
+    src = """\
+    import json
+    def load(path, out, doc):
+        with open("state/progress.json") as f:
+            data = json.load(f)
+        with open("state/progress.json", "r") as f:
+            data = json.load(f)
+        with open(path + ".tmp", "w") as f:
+            json.dump(doc, f)
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        return data
+    """
+    assert lint(src, path="pulsarutils_tpu/io/fixture.py") == []
+
+
+def test_atomic_write_sanctioned_in_helper_module():
+    src = """\
+    def append_jsonl(path, line):
+        with open("x.jsonl", "a") as f:
+            f.write(line)
+    """
+    assert lint(src, path="pulsarutils_tpu/io/atomic.py") == []
+
+
+def test_atomic_write_waivable():
+    src = """\
+    def forge(doc):
+        # putpu-lint: disable=atomic-write — test fixture forges a torn file
+        with open("torn.json", "w") as f:
+            f.write(doc)
+    """
+    findings = lint_source(textwrap.dedent(src),
+                           path="pulsarutils_tpu/io/fixture.py")
+    # lint() strips waived findings; prove the waiver (not silence)
+    assert findings == []
+
+
 # -- the CLI + the committed-tree meta-invariant -----------------------------
 
 def _run_cli(*args, check=False):
@@ -663,7 +741,8 @@ def test_committed_tree_runs_at_least_six_checkers():
     rep = project.report()
     assert rep["clean"]
     assert {"retrace", "device-trip", "lock-discipline", "metric-name",
-            "broad-except", "float64-leak"} <= set(rep["checkers"])
+            "broad-except", "float64-leak", "atomic-write"} \
+        <= set(rep["checkers"])
     assert rep["files"] > 50
 
 

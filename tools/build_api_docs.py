@@ -55,6 +55,8 @@ MODULES = [
     "pulsarutils_tpu.fleet.protocol",
     "pulsarutils_tpu.fleet.coordinator",
     "pulsarutils_tpu.fleet.worker",
+    "pulsarutils_tpu.fleet.journal",
+    "pulsarutils_tpu.io.atomic",
     "pulsarutils_tpu.resilience.memory_budget",
     "pulsarutils_tpu.resilience.ladder",
     "pulsarutils_tpu.io.sigproc",

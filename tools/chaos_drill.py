@@ -21,7 +21,14 @@ asserts the contracts ``docs/robustness.md`` documents:
   unrecoverable class must reach DEGRADED/CRITICAL while the fault is
   live and — when clean chunks follow the last affected one — recover
   back to OK.  Each class's verdict transitions land in the drill
-  record (``classes.<name>.health.transitions``).
+  record (``classes.<name>.health.transitions``);
+* the **fleet control plane** (ISSUE 15) survives its own failure
+  matrix: ``killed_coordinator`` (journal replay + ledger re-derive +
+  epoch-fenced re-steal), ``partitioned_worker`` (a zombie computing
+  through a steal has its late artifact writes fenced and its
+  completion stale-rejected, audit clean) and ``torn_journal`` (torn
+  tail truncated to a ``.corrupt`` backup) all finish byte-identical
+  to the baseline.
 
 Wired as ``bench_suite.py`` config 9 so the drill result lands next to
 the perf-gate artifacts; the same matrix runs as a ``slow``+``chaos``
@@ -350,6 +357,16 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     log(f"chaos drill: class torn_ledger: "
         f"{'PASS' if classes['torn_ledger']['ok'] else 'FAIL'}")
 
+    # coordinator-crash / partition classes (ISSUE 15): the fleet
+    # control plane under the same byte-identity contract
+    for name, fn in (("killed_coordinator", run_killed_coordinator_class),
+                     ("partitioned_worker", run_partitioned_worker_class),
+                     ("torn_journal", run_torn_journal_class)):
+        log(f"chaos drill: class {name} (recoverable)")
+        classes[name] = fn(base_dir, path, baseline, fingerprint, log)
+        log(f"chaos drill: class {name}: "
+            f"{'PASS' if classes[name]['ok'] else 'FAIL ' + str(classes[name])}")
+
     recovered = sum(1 for r in classes.values()
                     if r["recoverable"] and r["ok"])
     contained = sum(1 for r in classes.values()
@@ -369,6 +386,209 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     if not keep and workdir is None:
         shutil.rmtree(base_dir, ignore_errors=True)
     return result
+
+
+# ---------------------------------------------------------------------------
+# coordinator-crash / partition chaos classes (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+#: strip the driver-session knobs off SEARCH_KW: leases carry only the
+#: protocol whitelist
+_FLEET_CONFIG_KEYS = ("make_plots", "progress")
+
+
+def _fleet_config():
+    return {k: v for k, v in SEARCH_KW.items()
+            if k not in _FLEET_CONFIG_KEYS}
+
+
+def _drain_after_first(worker):
+    """Wrap a worker's unit runner to drain after its first unit — the
+    deterministic 'mid-survey' state every crash class needs."""
+    orig = worker._run_unit
+
+    def wrapped(lease):
+        result = orig(lease)
+        worker.drain()
+        return result
+
+    worker._run_unit = wrapped
+
+
+def run_killed_coordinator_class(base_dir, path, baseline, fingerprint,
+                                 log=print):
+    """**killed_coordinator**: one unit completes, one lease is left in
+    flight, then the coordinator is killed (its in-memory state
+    dropped — every journal record was already flushed at append, so
+    this is exactly what a SIGKILL leaves behind).  ``recover()``
+    replays the journal, re-derives outstanding units from the
+    ledgers, re-steals the stranded lease under a bumped epoch, and a
+    fresh worker finishes the survey byte-identical to the
+    uninterrupted baseline."""
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    outdir = os.path.join(base_dir, "killed_coordinator")
+    t0 = time.time()
+    first = FleetCoordinator(outdir, lease_ttl_s=60.0,
+                             chunks_per_unit=1, auto_sweep=False)
+    server = start_obs_server(0, fleet=first)
+    first.add_survey([path], **_fleet_config())
+    worker = FleetWorker(f"http://127.0.0.1:{server.port}",
+                         http_port=None)
+    _drain_after_first(worker)
+    worker.run()
+    ghost = first.register({})["worker"]
+    stranded = first.lease({"worker": ghost, "max_units": 1})["leases"]
+    server.close()
+    first.close()
+    del first      # the kill: nothing beyond the journal survives
+
+    second = FleetCoordinator.recover(outdir, lease_ttl_s=60.0,
+                                      chunks_per_unit=1,
+                                      auto_sweep=False)
+    # the stranded lease was re-stolen with a bumped fencing epoch
+    restolen = [u for u in second._units.values()
+                if stranded and u.id == stranded[0]["unit"]]
+    epoch_bumped = bool(restolen) and stranded \
+        and restolen[0].epoch > stranded[0]["epoch"]
+    server2 = start_obs_server(0, fleet=second)
+    finisher = FleetWorker(f"http://127.0.0.1:{server2.port}",
+                           http_port=None)
+    finisher.run(max_idle_s=60.0)
+    done = second.survey_done
+    server2.close()
+    second.close()
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    return {"recoverable": True, "fired": 1,
+            "units_before_kill": worker.units_done,
+            "stranded_leases": len(stranded),
+            "epoch_bumped": bool(epoch_bumped),
+            "survey_done": done,
+            "byte_identical": not diffs, "diffs": diffs,
+            "wall_s": round(time.time() - t0, 2),
+            "ok": (done and not diffs and bool(stranded)
+                   and bool(epoch_bumped)
+                   and worker.units_done == 1)}
+
+
+def run_partitioned_worker_class(base_dir, path, baseline, fingerprint,
+                                 log=print):
+    """**partitioned_worker**: a zombie worker hangs mid-dispatch far
+    past its lease TTL (the compute side of a partition: it keeps
+    working while unreachable), the unit is stolen and finished at a
+    bumped epoch, and when the zombie wakes its late artifact writes
+    are rejected by the epoch fence, its completion is rejected as
+    stale, and the audit shows zero inconsistencies — with the survey
+    output byte-identical to the baseline."""
+    from pulsarutils_tpu.faults.audit import audit_run
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.obs import metrics as obs_metrics
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    outdir = os.path.join(base_dir, "partitioned_worker")
+    t0 = time.time()
+    fenced_before = obs_metrics.counter(
+        "putpu_fleet_fenced_writes_total").value
+    # the zombie wedges inside the HIT chunk's dispatch: after the
+    # steal it will still compute the chunk and try to persist the
+    # candidate — the exact write the fence exists to reject
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="hang",
+                                seconds=10.0, chunks=(HIT_CHUNKS[0],),
+                                times=1)])
+    coordinator = FleetCoordinator(outdir, lease_ttl_s=2.5,
+                                   chunks_per_unit=1,
+                                   probe_interval_s=0.25)
+    server = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{server.port}"
+    coordinator.add_survey([path], **_fleet_config())
+    try:
+        import threading
+
+        with plan.armed():
+            zombie = FleetWorker(url, http_port=None, max_units=1)
+            zt = threading.Thread(target=zombie.run,
+                                  kwargs={"max_idle_s": 60.0})
+            zt.start()
+            stolen = _wait_for(
+                lambda: coordinator.progress_doc()["stats"]["expired"]
+                >= 1, timeout_s=60)
+            rescuer = FleetWorker(url, http_port=None)
+            rescuer.run(max_idle_s=30.0)
+            zt.join(timeout=120.0)
+        done = coordinator.survey_done
+        stats = coordinator.progress_doc()["stats"]
+    finally:
+        server.close()
+        coordinator.close()
+    fenced = obs_metrics.counter(
+        "putpu_fleet_fenced_writes_total").value - fenced_before
+    audit = audit_run(outdir, fingerprint, root="survey")
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    return {"recoverable": True, "fired": plan.fired(),
+            "stolen": stolen, "survey_done": done,
+            "fenced_writes": int(fenced),
+            "stale_epochs": stats["stale_epochs"],
+            "audit_ok": audit["ok"], "audit_issues": audit["issues"],
+            "byte_identical": not diffs, "diffs": diffs,
+            "wall_s": round(time.time() - t0, 2),
+            "ok": (bool(plan.fired()) and stolen and done and not diffs
+                   and fenced >= 1 and stats["stale_epochs"] >= 1
+                   and audit["ok"])}
+
+
+def run_torn_journal_class(base_dir, path, baseline, fingerprint,
+                           log=print):
+    """**torn_journal**: the coordinator dies AND its final journal
+    append was torn mid-line.  Replay truncates the tail to a
+    ``.corrupt`` backup and recovers from the good prefix + the
+    ledgers; the survey still finishes byte-identical."""
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.journal import JOURNAL_NAME
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    outdir = os.path.join(base_dir, "torn_journal")
+    t0 = time.time()
+    first = FleetCoordinator(outdir, lease_ttl_s=60.0,
+                             chunks_per_unit=1, auto_sweep=False)
+    server = start_obs_server(0, fleet=first)
+    first.add_survey([path], **_fleet_config())
+    worker = FleetWorker(f"http://127.0.0.1:{server.port}",
+                         http_port=None)
+    _drain_after_first(worker)
+    worker.run()
+    server.close()
+    first.close()
+    del first
+    journal_path = os.path.join(outdir, JOURNAL_NAME)
+    with open(journal_path, "rb") as f:
+        blob = f.read()
+    with open(journal_path, "wb") as f:
+        f.write(blob[: len(blob) - 7])   # torn mid-append
+    second = FleetCoordinator.recover(outdir, lease_ttl_s=60.0,
+                                      chunks_per_unit=1,
+                                      auto_sweep=False)
+    backup_kept = os.path.exists(journal_path + ".corrupt")
+    server2 = start_obs_server(0, fleet=second)
+    finisher = FleetWorker(f"http://127.0.0.1:{server2.port}",
+                           http_port=None)
+    finisher.run(max_idle_s=60.0)
+    done = second.survey_done
+    server2.close()
+    second.close()
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    return {"recoverable": True, "fired": 1, "backup_kept": backup_kept,
+            "survey_done": done,
+            "byte_identical": not diffs, "diffs": diffs,
+            "wall_s": round(time.time() - t0, 2),
+            "ok": done and not diffs and backup_kept}
 
 
 # ---------------------------------------------------------------------------
