@@ -63,6 +63,14 @@ all). Failures in one config don't stop the others.
      uninterrupted/recovered wall, forced to 0.0 on any
      ledger/candidate byte divergence or a recovery that did not
      actually recover
+ 20  acceleration-backend A/B (ISSUE 16): a synthetic binary pulsar
+     with nonzero jerk searched over the identical (accel, jerk)
+     trial grid by the time_stretch (one FFT per trial) and fdas
+     (one FFT per DM + z/w-response correlation) backends on the jit
+     path — value = time_stretch/fdas wall at matched trial counts,
+     forced to 0.0 when either backend's top candidate misses the
+     injected (DM, P, accel, jerk) cell or the tables fail the
+     cross-backend equivalence harness
 
 Sizes scale down with BENCH_PRESET=quick for CPU smoke runs.
 """
@@ -1595,11 +1603,102 @@ def config19(quick):
           "recovered_wall_s": round(killed["wall"], 2)})
 
 
+def config20(quick):
+    """Acceleration-backend A/B (ISSUE 16): the same synthetic binary
+    pulsar — nonzero jerk, injected at a known (DM row, Fourier bin,
+    accel trial, jerk trial) cell — searched over the IDENTICAL
+    (accel, jerk) trial grid by both trial formulations on the jit
+    path:
+
+    * ``time_stretch`` — PR 12's stretch-resample + one rfft per trial;
+    * ``fdas`` — one rfft per DM + batched z/w-response correlation
+      (ISSUE 16's tentpole).
+
+    ``value`` is the time_stretch/fdas steady-state wall ratio at
+    matched trial counts (> 1.0 means the correlation formulation
+    wins) — FORCED to 0.0, far past any tolerance, when either
+    backend's top candidate misses the injected cell or the two
+    tables fail the cross-backend equivalence harness
+    (:func:`~pulsarutils_tpu.tuning.autotune.accel_tables_match`:
+    discrete fields exact, sigma within the documented scalloping
+    tolerance).  The injection sits at ~0.35x Nyquist with the search
+    band cut at ``1.25 f0``: high enough that the 45-trial grid is
+    non-degenerate at ``f0``, low enough that stretch scalloping stays
+    a few percent.
+    """
+    import jax.numpy as jnp
+
+    from pulsarutils_tpu.periodicity.accel import accel_search
+    from pulsarutils_tpu.periodicity.fdas import fdas_search
+    from pulsarutils_tpu.tuning.autotune import (accel_tables_match,
+                                                 synthetic_accel_plane)
+
+    tsamp, nsamples, ndm = 5e-4, 16384, 8
+    accels = np.linspace(-2e5, 2e5, 9)
+    jerks = np.linspace(-5e4, 5e4, 5)
+    inj_accel, inj_jerk = 6, 3  # grid indices of the injected trial
+    inj_dm = ndm // 3
+    k0 = int(round(0.175 * nsamples))  # the injection Fourier bin
+    f0 = k0 / (nsamples * tsamp)
+    plane = synthetic_accel_plane(ndm, nsamples, tsamp,
+                                  float(accels[inj_accel]),
+                                  jerk=float(jerks[inj_jerk]), seed=20)
+    kw = dict(jerks=jerks, max_harmonics=1, fmax=1.25 * f0, topk=8,
+              xp=jnp)
+
+    # warm-up arm per backend absorbs the compiles out of the timed
+    # region; each call's host-side result table is the dispatch fence
+    t_stretch = accel_search(plane, tsamp, accels, **kw)
+    t_fdas = fdas_search(plane, tsamp, accels, **kw)
+
+    reps = 3 if quick else 5
+
+    def steady_wall(fn):
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(plane, tsamp, accels, **kw)
+            walls.append(time.perf_counter() - t0)
+        walls.sort()
+        return walls[len(walls) // 2]
+
+    stretch_wall = steady_wall(accel_search)
+    fdas_wall = steady_wall(fdas_search)
+
+    def top_ok(tbl, name):
+        got = (int(tbl["dm_index"][0]), int(tbl["accel_index"][0]),
+               int(tbl["jerk_index"][0]), int(tbl["freq_bin"][0]))
+        want = (inj_dm, inj_accel, inj_jerk, k0)
+        if got[:3] != want[:3] or abs(got[3] - want[3]) > 1:
+            log(f"config 20: {name} top candidate {got} missed the "
+                f"injected cell {want}")
+            return False
+        return True
+
+    cell_ok = (top_ok(t_stretch, "time_stretch")
+               and top_ok(t_fdas, "fdas"))
+    tables_ok = accel_tables_match(t_stretch, t_fdas)
+    if not tables_ok:
+        log("config 20: backends fail the cross-backend table harness")
+    ok = cell_ok and tables_ok
+    emit({"config": 20, "metric": "accel-backend A/B: jerked binary "
+          f"pulsar (f0 {f0:.1f} Hz, accel {accels[inj_accel]:g} m/s^2, "
+          f"jerk {jerks[inj_jerk]:g} m/s^3) over {len(accels)} accel x "
+          f"{len(jerks)} jerk trials, time_stretch vs fdas",
+          "value": round(stretch_wall / fdas_wall, 4) if ok else 0.0,
+          "unit": "x (time_stretch/fdas wall; 0 = missed injected cell "
+                  "or cross-backend table divergence)",
+          "recovered_cell": bool(cell_ok),
+          "tables_match": bool(tables_ok),
+          "time_stretch_wall_s": round(stretch_wall, 3),
+          "fdas_wall_s": round(fdas_wall, 3)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13, 14, 15, 16, 17, 18, 19])
+                                 13, 14, 15, 16, 17, 18, 19, 20])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -1629,7 +1728,7 @@ def main(argv=None):
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
            15: config15, 16: config16, 17: config17, 18: config18,
-           19: config19}
+           19: config19, 20: config20}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

@@ -62,7 +62,8 @@ _FORWARD_KEYS = ("snr_threshold", "max_chunks", "chunk_length",
 #: keys a ``workload="periodicity"`` job may carry on top of the shared
 #: ones (ISSUE 13); ``period_sigma_threshold`` maps onto the driver's
 #: ``sigma_threshold``
-_PERIOD_KEYS = ("accel_max", "n_accel", "period_sigma_threshold")
+_PERIOD_KEYS = ("accel_max", "n_accel", "jerk_max", "n_jerk",
+                "accel_backend", "period_sigma_threshold")
 
 #: keys only the batched multibeam runner understands — rejected
 #: explicitly on periodicity jobs (silently dropping a requested knob
@@ -124,6 +125,13 @@ def validate_spec(spec):
                 "periodicity job does not run")
         if float(spec.get("accel_max", 0.0)) < 0:
             raise ValueError("accel_max must be >= 0")
+        if float(spec.get("jerk_max", 0.0)) < 0:
+            raise ValueError("jerk_max must be >= 0")
+        backend_choice = spec.get("accel_backend", "auto")
+        if backend_choice not in ("auto", "time_stretch", "fdas"):
+            raise ValueError(
+                f"accel_backend={backend_choice!r}: expected 'auto', "
+                "'time_stretch' or 'fdas'")
     else:
         bad = sorted(set(spec) & set(_PERIOD_KEYS))
         if bad:
@@ -487,6 +495,8 @@ class SurveyService:
                              job=job.id).inc()
 
         kwargs = {k: spec[k] for k in ("accel_max", "n_accel",
+                                       "jerk_max", "n_jerk",
+                                       "accel_backend",
                                        "snr_threshold", "chunk_length",
                                        "new_sample_time") if k in spec}
         if "period_sigma_threshold" in spec:

@@ -33,6 +33,18 @@ def build_parser():
     parser.add_argument("--n-accel", type=int, default=None,
                         help="override the physics-spaced trial count "
                              "(odd; the grid always includes 0)")
+    parser.add_argument("--jerk-max", type=float, default=0.0,
+                        help="half-width of the trial jerk grid in "
+                             "m/s^3 (0 = no jerk axis)")
+    parser.add_argument("--n-jerk", type=int, default=None,
+                        help="override the physics-spaced jerk trial "
+                             "count (odd; the grid always includes 0)")
+    parser.add_argument("--accel-backend", default="auto",
+                        choices=["auto", "time_stretch", "fdas"],
+                        help="trial formulation: time_stretch (one FFT "
+                             "per trial), fdas (one FFT per DM + "
+                             "z/w-response correlation) or the "
+                             "measured auto selection")
     parser.add_argument("--sigma-threshold", type=float, default=8.0,
                         help="candidate significance floor (Gaussian-"
                              "equivalent sigma)")
@@ -92,7 +104,9 @@ def main(argv=None):
         kwargs["chunk_length"] = opts.chunk_length
     res = periodicity_search(
         opts.fname, opts.dmmin, opts.dmmax, accel_max=opts.accel_max,
-        n_accel=opts.n_accel, sigma_threshold=opts.sigma_threshold,
+        n_accel=opts.n_accel, jerk_max=opts.jerk_max,
+        n_jerk=opts.n_jerk, accel_backend=opts.accel_backend,
+        sigma_threshold=opts.sigma_threshold,
         topk=opts.topk, max_harmonics=opts.max_harmonics,
         fmin=opts.fmin, fmax=opts.fmax, nbin=opts.nbin,
         zap_path=opts.zap, rebin=rebin,

@@ -245,12 +245,23 @@ class FleetCoordinator:
             period_extra = {"workload": "periodicity",
                             "accel_max": float(config.get("accel_max",
                                                           0.0))}
+            if config.get("jerk_max"):
+                # conditional, mirroring the driver: a jerk-less lease
+                # must plan the exact pre-jerk fingerprint
+                period_extra["jerk_max"] = float(config["jerk_max"])
+            backend_choice = config.get("accel_backend", "auto")
+            if backend_choice not in ("auto", "time_stretch", "fdas"):
+                raise ValueError(
+                    f"accel_backend={backend_choice!r}: expected "
+                    "'auto', 'time_stretch' or 'fdas'")
         else:
             # periodicity-only keys on a single-pulse config would ride
             # the lease into search_by_chunks (which has no such
             # parameters) and fail every unit — reject at intake, the
             # validate_spec rule applied to the fleet's own front door
-            bad = sorted(set(config) & {"accel_max", "n_accel"})
+            bad = sorted(set(config) & {"accel_max", "n_accel",
+                                        "jerk_max", "n_jerk",
+                                        "accel_backend"})
             if bad:
                 raise ValueError(
                     f"search config keys {bad} require "

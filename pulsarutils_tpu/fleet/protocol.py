@@ -153,7 +153,8 @@ SEARCH_KEYS = (
     # fingerprint_extra and the worker routes the unit to
     # periodicity_search — the lease stays the single source of truth
     # for what a unit runs
-    "workload", "accel_max", "n_accel",
+    "workload", "accel_max", "n_accel", "jerk_max", "n_jerk",
+    "accel_backend",
 )
 
 

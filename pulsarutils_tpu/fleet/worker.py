@@ -351,11 +351,15 @@ class FleetWorker:
                 kwargs = dict(config)
                 accel_max = kwargs.pop("accel_max", 0.0)
                 n_accel = kwargs.pop("n_accel", None)
+                jerk_max = kwargs.pop("jerk_max", 0.0)
+                n_jerk = kwargs.pop("n_jerk", None)
+                accel_backend = kwargs.pop("accel_backend", "auto")
                 sigma = kwargs.pop("period_sigma_threshold", None)
                 kwargs.pop("period_search", None)
                 periodicity_search(
                     lease["fname"], accel_max=accel_max,
-                    n_accel=n_accel,
+                    n_accel=n_accel, jerk_max=jerk_max, n_jerk=n_jerk,
+                    accel_backend=accel_backend,
                     **({"sigma_threshold": sigma}
                        if sigma is not None else {}),
                     output_dir=lease["output_dir"], resume=True,

@@ -128,23 +128,25 @@ def simulate_accel_pulsar_data(freq=60.0, dm=150.0, accel=0.0,
                                tsamp=0.0005, nsamples=16384, nchan=32,
                                start_freq=1200., bandwidth=200.,
                                signal=1.0, noise=0.5, duty_cycle=0.05,
-                               floor=20.0, rng=None):
+                               floor=20.0, jerk=0.0, rng=None):
     """Simulate a dispersed **accelerated** (binary) pulsar.
 
-    Apparent phase ``phi(t) = f0 (t + a t^2 / (2 c))`` — the constant
-    line-of-sight-acceleration Doppler track the acceleration search
-    straightens with trial ``a == accel`` (sign convention pinned by
+    Apparent phase ``phi(t) = f0 (t + a t^2 / (2 c) + j t^3 / (6 c))``
+    — the constant line-of-sight-acceleration (+``jerk``) Doppler track
+    the acceleration search straightens with trial ``(a, j) == (accel,
+    jerk)`` (sign convention pinned by
     ``tests/test_period_backend.py``).  ``floor`` adds a constant
     offset so unsigned-integer quantisation in a written filterbank
     keeps the noise floor.  One generator serves the chaos drill,
-    bench config 17 and the tests — the injection physics must never
-    fork (drifting ground truths between the drill and the perf gate
-    would gate different claims).
+    bench configs 17/20 and the tests — the injection physics must
+    never fork (drifting ground truths between the drill and the perf
+    gate would gate different claims).
     """
     rng = np.random.default_rng(rng) \
         if not isinstance(rng, np.random.Generator) else rng
     t = np.arange(nsamples) * tsamp
-    phase = freq * (t + accel * t * t / (2.0 * _C_M_S))
+    phase = freq * (t + accel * t * t / (2.0 * _C_M_S)
+                    + jerk * t ** 3 / (6.0 * _C_M_S))
     dist = np.minimum(phase % 1.0, 1.0 - (phase % 1.0))
     profile = signal * np.exp(-0.5 * (dist / duty_cycle) ** 2)
     array = np.abs(rng.normal(np.broadcast_to(profile,

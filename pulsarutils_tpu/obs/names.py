@@ -114,6 +114,12 @@ METRIC_NAMES = {
         "chunk searches re-attempted after failure/timeout",
     "putpu_faults_injected_total":
         "fault-plan firings (labelled by site)",
+    "putpu_fdas_bank_entries_total":
+        "distinct (z, w) response templates built for fdas correlation "
+        "banks",
+    "putpu_fdas_trials_total":
+        "(DM, accel, jerk) trials scored by the fdas correlation "
+        "backend",
     "putpu_fleet_drains_total":
         "graceful worker drains (in-flight chunk finished, ledger "
         "flushed, unstarted leases returned)",
@@ -212,6 +218,9 @@ METRIC_NAMES = {
     "putpu_period_folds_total":
         "sift-surviving periodicity candidates phase-folded into "
         "profiles",
+    "putpu_period_grid_capped_total":
+        "trial grids coarsened by the max_trials cap (labelled by "
+        "axis: accel/jerk)",
     "putpu_period_jobs_total":
         "periodicity jobs completed end to end (accumulate -> trial "
         "search -> sift -> fold -> persist)",
@@ -222,7 +231,7 @@ METRIC_NAMES = {
         "accumulator resume snapshots persisted beside the chunk "
         "ledger",
     "putpu_period_trials_total":
-        "(DM, accel) periodicity trials searched",
+        "(DM, accel[, jerk]) periodicity trials searched",
     "putpu_persist_dead_letter_total":
         "candidate persists abandoned to the dead-letter manifest",
     "putpu_plan_cache_hits_total":

@@ -378,9 +378,13 @@ def render_markdown(rec):
     lines.append("")
     period = rec.get("periodicity")
     if period:
+        njerk = int(period.get("n_jerk") or 1)
+        jerk_txt = f" x {njerk} jerk trials" if njerk > 1 else ""
+        backend_txt = (f" ({period['accel_backend']} backend)"
+                       if period.get("accel_backend") else "")
         lines.append(
             f"{period.get('n_dm', '?')} DM x {period.get('n_accel', '?')} "
-            f"acceleration trials over a "
+            f"acceleration trials{jerk_txt}{backend_txt} over a "
             f"{_fmt(period.get('t_obs_s'), 1)} s accumulated "
             f"observation (rebin {period.get('rebin', '?')}, "
             f"{period.get('nout', '?')} samples); "
@@ -399,7 +403,17 @@ def render_markdown(rec):
                   f"f={_fmt(pc.get('freq'), 4)} Hz).")
             lines.append("")
         cands = period.get("candidates") or period.get("top") or []
-        if cands:
+        if cands and njerk > 1:
+            lines.append(_md_table(
+                ("f (Hz)", "P (s)", "DM", "accel (m/s^2)",
+                 "jerk (m/s^3)", "sigma", "nharm", "H"),
+                [(_fmt(c.get("freq"), 6),
+                  _fmt(1.0 / c["freq"], 6) if c.get("freq") else "-",
+                  _fmt(c.get("dm"), 2), _fmt(c.get("accel"), 1),
+                  _fmt(c.get("jerk"), 1),
+                  _fmt(c.get("sigma"), 1), c.get("nharm", "-"),
+                  _fmt(c.get("h"), 1)) for c in cands]))
+        elif cands:
             lines.append(_md_table(
                 ("f (Hz)", "P (s)", "DM", "accel (m/s^2)", "sigma",
                  "nharm", "H"),

@@ -143,21 +143,19 @@ def sf_log_to_sigma(log_sf, xp=np):
 # Spectral search over a dedispersed plane
 # ---------------------------------------------------------------------------
 
-def spectral_search(series, tsamp, max_harmonics=16, fmin=None, fmax=None,
-                    xp=np):
-    """FFT periodicity search of ``series`` (..., T).
+def score_normalized_power(power, nsamples, tsamp, max_harmonics=16,
+                           fmin=None, fmax=None, xp=np):
+    """Harmonic-sum scoring of an already Exp(1)-normalised power
+    spectrum ``power`` (..., nbins) of a length-``nsamples`` series.
 
-    For every harmonic-sum depth ``h`` in :data:`HARMONIC_SUMS` up to
-    ``max_harmonics``, find the most significant fundamental bin; return the
-    overall best per series.
-
-    Returns a dict of arrays (leading axes = ``series``'s batch axes):
-    ``freq`` (Hz), ``power`` (summed normalised power), ``nharm``,
-    ``log_sf`` (single-bin log false-alarm probability) and ``sigma``.
+    The scoring half of :func:`spectral_search`, split out so the
+    Fourier-domain acceleration backend
+    (:mod:`pulsarutils_tpu.periodicity.fdas`) can feed its correlated
+    trial spectra through the IDENTICAL harmonic-sum / false-alarm /
+    sigma chain — the cell-for-cell agreement contract between the
+    backends rides on this being one implementation, not two.
     """
-    series = xp.asarray(series)
-    t = series.shape[-1]
-    power = normalize_power(power_spectrum(series, xp=xp), xp=xp)
+    t = int(nsamples)
     nbins = power.shape[-1]
     freqs = xp.arange(nbins) / (t * tsamp)
 
@@ -201,6 +199,26 @@ def spectral_search(series, tsamp, max_harmonics=16, fmin=None, fmax=None,
         "log_sf": best_logsf,
         "sigma": sf_log_to_sigma(best_logsf, xp=xp),
     }
+
+
+def spectral_search(series, tsamp, max_harmonics=16, fmin=None, fmax=None,
+                    xp=np):
+    """FFT periodicity search of ``series`` (..., T).
+
+    For every harmonic-sum depth ``h`` in :data:`HARMONIC_SUMS` up to
+    ``max_harmonics``, find the most significant fundamental bin; return the
+    overall best per series.
+
+    Returns a dict of arrays (leading axes = ``series``'s batch axes):
+    ``freq`` (Hz), ``power`` (summed normalised power), ``nharm``,
+    ``log_sf`` (single-bin log false-alarm probability) and ``sigma``.
+    """
+    series = xp.asarray(series)
+    t = series.shape[-1]
+    power = normalize_power(power_spectrum(series, xp=xp), xp=xp)
+    return score_normalized_power(power, t, tsamp,
+                                  max_harmonics=max_harmonics,
+                                  fmin=fmin, fmax=fmax, xp=xp)
 
 
 _SPEC_KEYS = ("freq", "power", "nharm", "log_sf", "sigma")

@@ -39,6 +39,8 @@ autotuner's own equivalence contract).
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 from ..ops.periodicity import _SPEC_KEYS, spectral_search
@@ -46,44 +48,73 @@ from ..ops.rebin import stretch_resample
 from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
 
 __all__ = ["C_M_S", "accel_grid", "accel_search", "fractional_resample",
-           "stretch_index_table"]
+           "jerk_grid", "stretch_index_table", "trial_product"]
 
 #: speed of light (m/s) — acceleration trials are in m/s^2
 C_M_S = 299792458.0
 
 
-def stretch_index_table(accels, nsamples, tsamp):
-    """Per-trial gather indices for the quadratic time stretch.
+def stretch_index_table(accels, nsamples, tsamp, jerks=None):
+    """Per-trial gather indices for the quadratic/cubic time stretch.
 
     Sample ``n`` of the resampled series reads input sample
-    ``round(n - kappa n^2)`` with ``kappa = a t_samp / (2 c)`` — the
-    first-order Doppler path-length correction for constant line-of-
-    sight acceleration ``a``: a series generated with apparent phase
-    ``phi(t) = f0 (t + a t^2 / (2 c))`` is straightened back to a
-    constant ``f0`` by the SAME ``a`` (sign convention pinned by
-    ``tests/test_period_backend.py``).  Indices are computed in host
-    float64 (the anchored-fold rule: float32 index arithmetic drifts
-    by whole samples past ``n ~ 2^24``) and clipped to the series.
-    Returns ``(n_accel, nsamples)`` int32.
+    ``round(n - kappa n^2 - lam n^3)`` with ``kappa = a t_samp /
+    (2 c)`` and ``lam = j t_samp^2 / (6 c)`` — the first-order Doppler
+    path-length correction for constant line-of-sight acceleration
+    ``a`` and jerk ``j``: a series generated with apparent phase
+    ``phi(t) = f0 (t + a t^2 / (2 c) + j t^3 / (6 c))`` is
+    straightened back to a constant ``f0`` by the SAME ``(a, j)``
+    (sign convention pinned by ``tests/test_period_backend.py``).
+    Indices are computed in host float64 (the anchored-fold rule:
+    float32 index arithmetic drifts by whole samples past ``n ~
+    2^24``) and clipped to the series.  ``jerks`` broadcasts against
+    ``accels`` (default all-zero).  Returns ``(n_trials, nsamples)``
+    int32.
     """
     n = np.arange(int(nsamples), dtype=np.float64)
-    kappa = (np.atleast_1d(np.asarray(accels, dtype=np.float64))[:, None]
-             * float(tsamp) / (2.0 * C_M_S))
-    idx = np.rint(n[None, :] - kappa * n[None, :] ** 2)
+    accels = np.atleast_1d(np.asarray(accels, dtype=np.float64))
+    kappa = accels[:, None] * float(tsamp) / (2.0 * C_M_S)
+    idx = n[None, :] - kappa * n[None, :] ** 2
+    if jerks is not None:
+        jerks = np.broadcast_to(
+            np.atleast_1d(np.asarray(jerks, dtype=np.float64)), accels.shape)
+        lam = jerks[:, None] * float(tsamp) ** 2 / (6.0 * C_M_S)
+        idx = idx - lam * n[None, :] ** 3
+    idx = np.rint(idx)
     return np.clip(idx, 0, int(nsamples) - 1).astype(np.int32)
 
 
-def fractional_resample(series, accel, tsamp, xp=np):
-    """Resample ``series`` (..., T) for one trial acceleration.
+def fractional_resample(series, accel, tsamp, jerk=0.0, xp=np):
+    """Resample ``series`` (..., T) for one trial acceleration (+jerk).
 
     The fractional-stretch generalisation of ``quick_resample``: where
     the integer rebin sums fixed blocks, this gathers each output
-    sample from a quadratically-drifting input position
-    (:func:`stretch_index_table`).  ``accel=0`` is the identity.
+    sample from a quadratically (cubically, with ``jerk``) drifting
+    input position (:func:`stretch_index_table`).  ``accel=0, jerk=0``
+    is the identity.
     """
-    idx = stretch_index_table(accel, np.shape(series)[-1], tsamp)[0]
+    idx = stretch_index_table(accel, np.shape(series)[-1], tsamp,
+                              jerks=jerk)[0]
     return stretch_resample(series, idx if xp is np else xp.asarray(idx),
                             xp=xp)
+
+
+def _capped_side(n_side, max_trials, axis):
+    """Bound a symmetric grid at ``max_trials``; a binding cap is a
+    warning + ``putpu_period_grid_capped_total`` tick, never silent
+    (the no-silent-caps rule: a user asking for finer resolution than
+    the cap allows must be able to see the grid coarsened)."""
+    cap = (int(max_trials) - 1) // 2
+    if n_side > cap:
+        warnings.warn(
+            f"{axis} grid needs {2 * n_side + 1} trials for the "
+            f"requested range but max_trials={int(max_trials)} caps it "
+            f"at {2 * cap + 1}; trial spacing widens accordingly",
+            UserWarning, stacklevel=3)
+        from ..obs import metrics
+        metrics.counter("putpu_period_grid_capped_total", axis=axis).inc()
+        return cap
+    return n_side
 
 
 def accel_grid(accel_max, tsamp, nsamples, f_ref=None, max_trials=1025):
@@ -93,8 +124,9 @@ def accel_grid(accel_max, tsamp, nsamples, f_ref=None, max_trials=1025):
     a signal at ``f_ref`` under ~one Fourier bin between adjacent
     trials; ``f_ref`` defaults to the Nyquist frequency (conservative —
     every lower frequency is oversampled).  Always includes 0 exactly;
-    ``max_trials`` bounds the grid (spacing widens past it, logged by
-    the driver).  ``accel_max <= 0`` returns the single zero trial.
+    ``max_trials`` bounds the grid (spacing widens past it, with a
+    warning and a ``putpu_period_grid_capped_total`` tick when the cap
+    binds).  ``accel_max <= 0`` returns the single zero trial.
     """
     if accel_max <= 0:
         return np.zeros(1)
@@ -103,9 +135,46 @@ def accel_grid(accel_max, tsamp, nsamples, f_ref=None, max_trials=1025):
         f_ref = 0.5 / float(tsamp)
     da = 2.0 * C_M_S / (float(f_ref) * t_obs * t_obs)
     n_side = max(int(np.ceil(float(accel_max) / da)), 1)
-    n_side = min(n_side, (int(max_trials) - 1) // 2)
+    n_side = _capped_side(n_side, max_trials, "accel")
     return (np.arange(-n_side, n_side + 1, dtype=np.float64)
             * (float(accel_max) / n_side))
+
+
+def jerk_grid(jerk_max, tsamp, nsamples, f_ref=None, max_trials=1025):
+    """Symmetric trial jerks ``[-jerk_max, jerk_max]`` (m/s^3).
+
+    Spacing ``dj = 6 c / (f_ref T_obs^3)`` keeps the residual
+    quadratic drift of a signal at ``f_ref`` under ~one w-response
+    width between adjacent trials (the w-response of a jerk trial is
+    ``w = f j T^3 / c`` bins wide, so unit ``w`` steps at ``f_ref``
+    mirror the unit-``z`` rule of :func:`accel_grid`).  Always
+    includes 0 exactly — the pure-acceleration trials survive any
+    jerk sweep — and caps at ``max_trials`` with the same warn+count
+    rule.  ``jerk_max <= 0`` returns the single zero trial.
+    """
+    if jerk_max <= 0:
+        return np.zeros(1)
+    t_obs = float(nsamples) * float(tsamp)
+    if f_ref is None:
+        f_ref = 0.5 / float(tsamp)
+    dj = 6.0 * C_M_S / (float(f_ref) * t_obs * t_obs * t_obs)
+    n_side = max(int(np.ceil(float(jerk_max) / dj)), 1)
+    n_side = _capped_side(n_side, max_trials, "jerk")
+    return (np.arange(-n_side, n_side + 1, dtype=np.float64)
+            * (float(jerk_max) / n_side))
+
+
+def trial_product(accels, jerks):
+    """Flatten the ``(accel, jerk)`` grid accel-major.
+
+    Returns ``(trial_accels, trial_jerks)`` of length ``n_accel *
+    n_jerk`` — trial ``t`` is ``(accels[t // n_jerk], jerks[t %
+    n_jerk])``, the ordering every backend and the result table share.
+    """
+    accels = np.atleast_1d(np.asarray(accels, dtype=np.float64))
+    jerks = np.atleast_1d(np.asarray(jerks if jerks is not None else [0.0],
+                                     dtype=np.float64))
+    return np.repeat(accels, len(jerks)), np.tile(jerks, len(accels))
 
 
 def _select_topk(sigma, k):
@@ -117,19 +186,29 @@ def _select_topk(sigma, k):
     return order[: min(int(k), flat.size)]
 
 
-def _result_table(stacked, flat_idx, accels, tsamp, nsamples):
-    """Assemble the candidate table from a ``(n_accel, 5, ndm)`` score
-    stack and selected flat indices."""
-    naccel, _, ndm = stacked.shape
+def _result_table(stacked, flat_idx, accels, tsamp, nsamples, jerks=None):
+    """Assemble the candidate table from a ``(n_trials, 5, ndm)`` score
+    stack and selected flat indices.  With a jerk axis the trial index
+    splits accel-major (``trial = accel_index * n_jerk + jerk_index``,
+    the :func:`trial_product` ordering); without one the table is
+    exactly the pre-jerk layout plus all-zero jerk columns."""
+    _, _, ndm = stacked.shape
+    jerks = np.atleast_1d(np.asarray(jerks if jerks is not None else [0.0],
+                                     dtype=np.float64))
+    njerk = len(jerks)
     flat_idx = np.asarray(flat_idx, dtype=np.int64)
-    a_idx = flat_idx // ndm
+    t_idx = flat_idx // ndm
     d_idx = flat_idx % ndm
-    fields = {key: np.asarray(stacked[a_idx, i, d_idx])
+    a_idx = t_idx // njerk
+    j_idx = t_idx % njerk
+    fields = {key: np.asarray(stacked[t_idx, i, d_idx])
               for i, key in enumerate(_SPEC_KEYS)}
     return {
         "dm_index": d_idx.astype(np.int64),
         "accel_index": a_idx.astype(np.int64),
         "accel": np.asarray(accels, dtype=np.float64)[a_idx],
+        "jerk_index": j_idx.astype(np.int64),
+        "jerk": jerks[j_idx],
         "freq": fields["freq"].astype(np.float64),
         "freq_bin": np.rint(fields["freq"].astype(np.float64)
                             * nsamples * tsamp).astype(np.int64),
@@ -201,17 +280,19 @@ def _accel_program_sharded(mesh, tsamp, ndm_pad, nsamples, naccel_pad,
     return run
 
 
-def accel_search(plane, tsamp, accels, *, max_harmonics=16, fmin=None,
-                 fmax=None, topk=32, xp=np, mesh=None):
-    """Search the accumulated plane over the (DM, accel) trial grid.
+def accel_search(plane, tsamp, accels, *, jerks=None, max_harmonics=16,
+                 fmin=None, fmax=None, topk=32, xp=np, mesh=None):
+    """Search the accumulated plane over the (DM, accel[, jerk]) grid.
 
     ``plane`` is the ``(ndm, T)`` full-observation DM–time plane
     (:class:`~pulsarutils_tpu.periodicity.accumulate.DMTimeAccumulator`
-    ``.plane``); ``accels`` the trial accelerations (m/s^2, include 0).
+    ``.plane``); ``accels`` the trial accelerations (m/s^2, include 0)
+    and ``jerks`` the optional trial jerks (m/s^3, include 0) swept as
+    their accel-major cartesian product (:func:`trial_product`).
     Returns the top-``topk`` candidate table as a dict of aligned
-    arrays: ``dm_index, accel_index, accel, freq, freq_bin, power,
-    nharm, log_sf, sigma`` — sorted by descending sigma with the
-    deterministic tie rule shared by all paths.
+    arrays: ``dm_index, accel_index, accel, jerk_index, jerk, freq,
+    freq_bin, power, nharm, log_sf, sigma`` — sorted by descending
+    sigma with the deterministic tie rule shared by all paths.
 
     ``xp=numpy`` runs the host reference; ``xp=jax.numpy`` runs the
     single batched jitted program; ``mesh`` additionally shards the
@@ -220,14 +301,16 @@ def accel_search(plane, tsamp, accels, *, max_harmonics=16, fmin=None,
     plane = np.asarray(plane, dtype=np.float32) if xp is np else plane
     ndm, nsamples = np.shape(plane)
     accels = np.atleast_1d(np.asarray(accels, dtype=np.float64))
-    idx_table = stretch_index_table(accels, nsamples, tsamp)
-    naccel = len(accels)
+    t_accels, t_jerks = trial_product(accels, jerks)
+    idx_table = stretch_index_table(t_accels, nsamples, tsamp,
+                                    jerks=t_jerks)
+    ntrials = len(t_accels)
     lo = None if fmin is None else float(fmin)
     hi = None if fmax is None else float(fmax)
 
     if xp is np:
-        stacked = np.zeros((naccel, 5, ndm), dtype=np.float64)
-        for a in range(naccel):
+        stacked = np.zeros((ntrials, 5, ndm), dtype=np.float64)
+        for a in range(ntrials):
             res = spectral_search(
                 np.take(plane, idx_table[a], axis=-1), tsamp,
                 max_harmonics=max_harmonics, fmin=lo, fmax=hi, xp=np)
@@ -235,7 +318,8 @@ def accel_search(plane, tsamp, accels, *, max_harmonics=16, fmin=None,
                                    for k in _SPEC_KEYS])
         flat_idx = _select_topk(stacked[:, _SPEC_KEYS.index("sigma"), :],
                                 topk)
-        return _result_table(stacked, flat_idx, accels, tsamp, nsamples)
+        return _result_table(stacked, flat_idx, accels, tsamp, nsamples,
+                             jerks=jerks)
 
     import jax.numpy as jnp
 
@@ -243,29 +327,31 @@ def accel_search(plane, tsamp, accels, *, max_harmonics=16, fmin=None,
         n_dm_shards = mesh.shape["dm"]
         n_acc_shards = mesh.shape["chan"]
         ndm_pad = -(-ndm // n_dm_shards) * n_dm_shards
-        nacc_pad = -(-naccel // n_acc_shards) * n_acc_shards
+        nacc_pad = -(-ntrials // n_acc_shards) * n_acc_shards
         plane_dev = jnp.asarray(plane, dtype=jnp.float32)
         if ndm_pad != ndm:
             plane_dev = jnp.pad(plane_dev, ((0, ndm_pad - ndm), (0, 0)))
         idx_pad = idx_table
-        if nacc_pad != naccel:
+        if nacc_pad != ntrials:
             # pad with the zero-accel identity mapping; rows discarded
             ident = stretch_index_table([0.0], nsamples, tsamp)
             idx_pad = np.concatenate(
-                [idx_table, np.repeat(ident, nacc_pad - naccel, axis=0)])
+                [idx_table, np.repeat(ident, nacc_pad - ntrials, axis=0)])
         run = _accel_program_sharded(mesh, float(tsamp), ndm_pad,
                                      int(nsamples), nacc_pad,
                                      int(max_harmonics), lo, hi)
         stacked = np.asarray(run(plane_dev, jnp.asarray(idx_pad)),
-                             dtype=np.float64)[:naccel, :, :ndm]
+                             dtype=np.float64)[:ntrials, :, :ndm]
         flat_idx = _select_topk(stacked[:, _SPEC_KEYS.index("sigma"), :],
                                 topk)
-        return _result_table(stacked, flat_idx, accels, tsamp, nsamples)
+        return _result_table(stacked, flat_idx, accels, tsamp, nsamples,
+                             jerks=jerks)
 
     run = _accel_program(float(tsamp), int(ndm), int(nsamples),
-                         int(naccel), int(max_harmonics), lo, hi,
+                         int(ntrials), int(max_harmonics), lo, hi,
                          int(topk))
     stacked, flat_idx = run(jnp.asarray(plane, dtype=jnp.float32),
                             jnp.asarray(idx_table))
     return _result_table(np.asarray(stacked, dtype=np.float64),
-                         np.asarray(flat_idx), accels, tsamp, nsamples)
+                         np.asarray(flat_idx), accels, tsamp, nsamples,
+                         jerks=jerks)

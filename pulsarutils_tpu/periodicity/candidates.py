@@ -144,6 +144,9 @@ def candidate_list(table, trial_dms, sigma_threshold):
                    else float(d)),
             "accel_index": int(table["accel_index"][i]),
             "accel": float(table["accel"][i]),
+            "jerk_index": (int(table["jerk_index"][i])
+                           if "jerk_index" in table else 0),
+            "jerk": (float(table["jerk"][i]) if "jerk" in table else 0.0),
             "freq": float(table["freq"][i]),
             "freq_bin": int(table["freq_bin"][i]),
             "nharm": int(table["nharm"][i]),
@@ -235,8 +238,9 @@ def fold_candidates(accumulator, cands, *, nbin=32, oversample=8, xp=np):
     tsamp = accumulator.tsamp
     for cand in cands:
         series = accumulator.series(cand["dm_index"])
-        if cand["accel"]:
+        if cand["accel"] or cand.get("jerk"):
             series = fractional_resample(series, cand["accel"], tsamp,
+                                         jerk=cand.get("jerk", 0.0),
                                          xp=np)
         grid = refine_grid(cand["freq"], tsamp, series.shape[-1],
                            oversample=oversample)
@@ -257,8 +261,9 @@ def fold_candidates(accumulator, cands, *, nbin=32, oversample=8, xp=np):
     return cands
 
 
-_COLS = ("dm_index", "dm", "accel_index", "accel", "freq", "freq_bin",
-         "nharm", "power", "log_sf", "sigma", "freq_refined", "h", "m")
+_COLS = ("dm_index", "dm", "accel_index", "accel", "jerk_index", "jerk",
+         "freq", "freq_bin", "nharm", "power", "log_sf", "sigma",
+         "freq_refined", "h", "m")
 
 
 def save_candidates(path, cands, meta=None):

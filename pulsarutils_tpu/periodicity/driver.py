@@ -32,7 +32,7 @@ import numpy as np
 from ..faults import inject as fault_inject
 from ..obs import metrics as _metrics
 from ..utils.logging_utils import logger
-from .accel import accel_grid, accel_search
+from .accel import accel_grid, accel_search, jerk_grid
 from .accumulate import DMTimeAccumulator
 from .candidates import (ZapList, candidate_list, fold_candidates,
                          harmonic_ratio, save_candidates, sift_candidates)
@@ -87,7 +87,9 @@ def _canary_is_recovered(cand, freq, freq_tol):
 
 
 def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
-                       n_accel=None, sigma_threshold=8.0, topk=64,
+                       n_accel=None, jerk_max=0.0, n_jerk=None,
+                       accel_backend="auto",
+                       sigma_threshold=8.0, topk=64,
                        max_harmonics=16, fmin=None, fmax=None, nbin=32,
                        zap=None, zap_path=None, rebin="auto",
                        budget_bytes=None, snapshot_every=1,
@@ -104,12 +106,19 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
        (all its hardening knobs pass through ``search_kwargs``) and
        fold every chunk's dedispersed plane into one rebinned
        full-observation DM–time plane, sized by the memory budget;
-    2. **trial search** — the (DM, accel) sweep of :func:`~.accel.
-       accel_search` over ``accel_grid(accel_max, ...)`` (``n_accel``
-       overrides the grid size; ``accel_max=0`` searches the single
-       zero-acceleration trial), on the ``backend``/``mesh`` the
-       single-pulse leg used, with a host-numpy fallback on device
-       failure;
+    2. **trial search** — the (DM, accel[, jerk]) sweep over
+       ``accel_grid(accel_max, ...)`` x ``jerk_grid(jerk_max, ...)``
+       (``n_accel``/``n_jerk`` override the grid sizes; ``accel_max=0``
+       searches the single zero-acceleration trial and ``jerk_max=0``
+       adds no jerk axis), on the ``backend``/``mesh`` the single-pulse
+       leg used, with a host-numpy fallback on device failure.
+       ``accel_backend`` picks the trial formulation: ``"time_stretch"``
+       (:func:`~.accel.accel_search`, one rfft per trial),
+       ``"fdas"`` (:func:`~.fdas.fdas_search`, one rfft per DM +
+       batched z/w-response correlation) or ``"auto"`` (the measured
+       autotuner contender pair, :func:`~pulsarutils_tpu.tuning.
+       autotune.resolve_accel_backend` — below the tune floor this
+       resolves statically to ``time_stretch``, the pre-FDAS path);
     3. **candidates** — threshold at ``sigma_threshold``, zap-list /
        DM-grouping / harmonic sift (:mod:`~.candidates`), batched
        phase-folding of survivors;
@@ -147,8 +156,17 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
                 f"{k} is owned by the periodicity driver: the "
                 "full-observation stage replaces the per-chunk rescue "
                 "seam (use sigma_threshold for the candidate floor)")
+    if accel_backend not in ("auto", "time_stretch", "fdas"):
+        raise ValueError(
+            f"accel_backend must be 'auto', 'time_stretch' or 'fdas', "
+            f"got {accel_backend!r}")
     output_dir = output_dir or os.path.dirname(os.path.abspath(str(fname)))
     extra = {"workload": "periodicity", "accel_max": float(accel_max)}
+    if jerk_max:
+        # conditional on purpose: a jerk-less run's fingerprint (and so
+        # its ledger/snapshot/artifact names) stays byte-identical to
+        # every pre-jerk release — the driver-fingerprint rule
+        extra["jerk_max"] = float(jerk_max)
     plan_kw = {k: search_kwargs[k] for k in _PLAN_KEYS
                if k in search_kwargs}
     sp = plan_survey(fname, dmmin=dmmin, dmmax=dmmax, backend=backend,
@@ -241,8 +259,37 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
                                  max(n_accel, 3) | 1)
     else:
         accels = accel_grid(accel_max, tsamp_out, nout)
+    if n_jerk is not None:
+        # same odd-grid rule as n_accel: the exact zero-jerk trial is
+        # always present, n_jerk <= 1 means "no jerk axis"
+        n_jerk = int(n_jerk)
+        if jerk_max <= 0 or n_jerk <= 1:
+            jerks = np.zeros(1)
+        else:
+            jerks = np.linspace(-jerk_max, jerk_max, max(n_jerk, 3) | 1)
+    else:
+        jerks = jerk_grid(jerk_max, tsamp_out, nout)
+    # the single zero trial is "no jerk axis": the table layout, the
+    # trial count and the resume artifacts stay exactly the pre-jerk
+    # ones
+    jerks_axis = jerks if len(jerks) > 1 else None
     fmin_eff = fmin if fmin is not None else 4.0 / (nout * tsamp_out)
     freq_tol = 1.5 / (nout * tsamp_out)
+
+    chosen_backend = accel_backend
+    if chosen_backend == "auto":
+        chosen_backend = "time_stretch"
+        if backend == "jax":
+            try:
+                from ..tuning.autotune import resolve_accel_backend
+
+                chosen_backend = resolve_accel_backend(
+                    acc.ndm, nout, tsamp_out, accels, jerks=jerks_axis,
+                    max_harmonics=max_harmonics, fmin=fmin_eff,
+                    fmax=fmax, mesh=mesh)
+            except Exception as exc:  # putpu-lint: disable=broad-except — backend tuning must degrade to the static choice, never fail the job
+                logger.warning("accel backend resolution failed (%r); "
+                               "using time_stretch", exc)
 
     canary_info = None
     plane_search = acc.plane
@@ -251,6 +298,11 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
         canary_info = {"dm_index": c_row, "freq": c_freq,
                        "recovered": False}
 
+    if chosen_backend == "fdas":
+        from .fdas import fdas_search as search_fn
+    else:
+        search_fn = accel_search
+
     def run_trials():
         t0 = time.perf_counter()
         if backend == "jax":
@@ -258,8 +310,8 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
                 fault_inject.fire("period", backend="jax")
                 import jax.numpy as jnp
 
-                return accel_search(
-                    plane_search, tsamp_out, accels,
+                return search_fn(
+                    plane_search, tsamp_out, accels, jerks=jerks_axis,
                     max_harmonics=max_harmonics, fmin=fmin_eff,
                     fmax=fmax, topk=topk, xp=jnp, mesh=mesh), t0, "jax"
             except (ValueError, TypeError):
@@ -268,19 +320,24 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
                 logger.warning(
                     "periodicity trial dispatch failed (%r); falling "
                     "back to the host path", exc)
-        return accel_search(plane_search, tsamp_out, accels,
-                            max_harmonics=max_harmonics, fmin=fmin_eff,
-                            fmax=fmax, topk=topk, xp=np), t0, "numpy"
+        # the host fallback keeps the CHOSEN formulation — both
+        # backends have a pure-numpy reference path, and switching
+        # formulations mid-job would change the table's float fields
+        return search_fn(plane_search, tsamp_out, accels,
+                         jerks=jerks_axis,
+                         max_harmonics=max_harmonics, fmin=fmin_eff,
+                         fmax=fmax, topk=topk, xp=np), t0, "numpy"
 
     # trial_backend remembers an actual fallback: the fold stage below
     # must follow the sweep off a dead device, not re-enter jax and
     # crash the job after all the accumulation+sweep work succeeded
     table, t_trials, trial_backend = run_trials()
     _metrics.counter("putpu_period_trials_total").inc(
-        int(acc.ndm * len(accels)))
-    logger.info("periodicity trial sweep: %d DM x %d accel trials in "
-                "%.2fs", acc.ndm, len(accels),
-                time.perf_counter() - t_trials)
+        int(acc.ndm * len(accels) * len(jerks)))
+    logger.info("periodicity trial sweep: %d DM x %d accel%s trials in "
+                "%.2fs [%s]", acc.ndm, len(accels),
+                f" x {len(jerks)} jerk" if len(jerks) > 1 else "",
+                time.perf_counter() - t_trials, chosen_backend)
 
     raw = candidate_list(table, acc.trial_dms, sigma_threshold)
     _metrics.counter("putpu_period_candidates_total").inc(len(raw))
@@ -320,6 +377,8 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
             "fingerprint": sp["fingerprint"],
             "dmmin": float(dmmin), "dmmax": float(dmmax),
             "accel_max": float(accel_max), "n_accel": len(accels),
+            "jerk_max": float(jerk_max), "n_jerk": len(jerks),
+            "accel_backend": chosen_backend,
             "rebin": acc.rebin, "tsamp": acc.tsamp, "nout": acc.nout,
             "sigma_threshold": float(sigma_threshold),
             "max_harmonics": int(max_harmonics),
@@ -344,15 +403,16 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
     _metrics.counter("putpu_period_jobs_total").inc()
 
     summary = {
-        "n_dm": acc.ndm, "n_accel": len(accels), "nout": acc.nout,
+        "n_dm": acc.ndm, "n_accel": len(accels), "n_jerk": len(jerks),
+        "accel_backend": chosen_backend, "nout": acc.nout,
         "rebin": acc.rebin, "tsamp": acc.tsamp,
         "t_obs_s": round(acc.nout * acc.tsamp, 3),
         "raw_candidates": sift_stats["in"],
         "kept": sift_stats["kept"],
         "rejected": sift_stats["rejected"],
         "canary": canary_info,
-        "top": [{k: c[k] for k in ("dm", "accel", "freq", "sigma",
-                                   "nharm")}
+        "top": [{k: c[k] for k in ("dm", "accel", "jerk", "freq",
+                                   "sigma", "nharm")}
                 for c in kept[:5]],
     }
     logger.info("PERIOD_JSON %s", json.dumps(summary, default=float))
@@ -379,7 +439,7 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
                 periodicity=dict(summary,
                                  candidates=[
                                      {k: c.get(k) for k in
-                                      ("dm", "accel", "freq",
+                                      ("dm", "accel", "jerk", "freq",
                                        "freq_refined", "sigma", "nharm",
                                        "h", "m")}
                                      for c in kept]),
@@ -391,6 +451,7 @@ def periodicity_search(fname, dmmin=200, dmmax=800, *, accel_max=0.0,
 
     return {"complete": True, "candidates": kept, "sift": sift_stats,
             "table": table, "accumulator": acc, "accels": accels,
+            "jerks": jerks, "accel_backend": chosen_backend,
             "fingerprint": sp["fingerprint"],
             "candidates_path": cands_path, "snapshot_path": snap_path,
             "canary": canary_info, "hits": hits, "store": store}

@@ -1,0 +1,270 @@
+"""Fourier-domain acceleration/jerk search (FDAS): one FFT per DM row.
+
+The time-stretch backend (:mod:`.accel`) pays a full resample + rFFT
+*per trial* — ``O(n_trials * N log N)`` per DM row.  The PRESTO-lineage
+formulation (PulsarX, arxiv 2309.02544) transforms each DM row ONCE and
+recovers every ``(accel, jerk)`` trial by correlating the complex
+spectrum against short precomputed z/w-response templates
+(:mod:`pulsarutils_tpu.ops.zresponse`) — ``O(N log N + n_trials *
+nbins * m)`` with template width ``m ~ 2 z_max``, the batched
+short-kernel contraction XLA fuses well, and the only formulation under
+which a jerk axis with its multiplied trial count is tractable.
+
+The search sweeps *physical* ``(a, j)`` trials — the same grid, trial
+ordering and result-table layout as :func:`.accel.accel_search` — so
+the drift each template must match is frequency dependent (``z_k = k a
+T / c`` bins at spectrum bin ``k``): every ``(trial, bin)`` pair is
+quantised onto the template bank and gathered per bin.  The correlated
+powers then feed the IDENTICAL scoring chain
+(:func:`~pulsarutils_tpu.ops.periodicity.score_normalized_power` —
+harmonic sum, Erlang false-alarm, sigma) and the identical top-k rule,
+so fdas host/jit/mesh tables agree cell for cell exactly like the
+stretch backend's three paths do.
+
+Cross-backend equivalence is *statistical on noise, matched on
+signals*: both backends estimate the same matched-filter statistic but
+weight the noise differently (a stretch trial re-bins the noise, an
+fdas trial correlates a short window of it), so only *significant*
+cells — the ones a search acts on — agree between backends (discrete
+fields exactly, sigma to a few percent).  The autotuner's equivalence
+harness (:func:`pulsarutils_tpu.tuning.autotune.resolve_accel_backend`)
+enforces exactly that contract before any timing is trusted.
+
+Execution contract (the repo-wide kernel rule): host loop / ONE jitted
+program (``counted_plan_cache`` entry ``period_fdas``) / the same body
+``shard_map``-ped over the ``(dm, chan)`` mesh (``period_fdas_mesh``)
+with DM rows on ``dm`` and trial blocks on ``chan``, exactly as
+``_accel_program_sharded`` shards the stretch sweep.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.periodicity import (HARMONIC_SUMS, _SPEC_KEYS, _dc_mask,
+                               normalize_power, score_normalized_power)
+from ..ops.zresponse import bank_for_trials
+from ..tuning.geometry import PLAN_CACHE_SIZE, counted_plan_cache
+from .accel import _result_table, _select_topk, trial_product
+
+__all__ = ["fdas_search"]
+
+
+def _band_slice(nbins, nsamples, tsamp, fmax, max_harmonics, accels, jerks,
+                pad=8):
+    """Spectrum prefix the correlation must cover: the scoring band up
+    to ``fmax`` times the deepest harmonic the scorer can gather, plus
+    a template-width margin so edge-of-band windows keep their tails.
+
+    This is the fdas cost lever: template width grows with the highest
+    *correlated* bin (``z_k = k a T / c``), so a band-limited search
+    (``fmax`` set) correlates a short prefix with narrow templates
+    instead of Nyquist-wide ones.  ``fmax=None`` keeps the full
+    spectrum — numerics are then identical to an unsliced program.
+    """
+    if fmax is None:
+        return int(nbins)
+    hi = min(int(nbins), int(float(fmax) * int(nsamples) * float(tsamp)) + 1)
+    h_max = max([h for h in HARMONIC_SUMS if h <= int(max_harmonics)] or [1])
+    lo_slice = min(int(nbins), hi * h_max)
+    # conservative half-width estimate at the slice edge (same formula
+    # as the bank builder) to keep edge windows complete
+    from ..ops.zresponse import MAX_HALF_WIDTH
+    from .accel import C_M_S
+    t_obs = float(nsamples) * float(tsamp)
+    z_top = float(np.max(np.abs(accels))) * t_obs / C_M_S * (lo_slice - 1)
+    w_top = float(np.max(np.abs(jerks))) * t_obs ** 2 / C_M_S * (lo_slice - 1)
+    half = min(int(np.ceil(z_top / 2.0 + w_top / 3.0)) + pad,
+               MAX_HALF_WIDTH)
+    return min(int(nbins), lo_slice + 2 * half)
+
+
+def _correlate_one(X, filt, gidx_row, tidx_row, nbins, m, xp):
+    """Correlate spectra ``X`` (ndm, nbins) with one trial's per-bin
+    templates: gather an ``m``-tap window of ``X`` around each bin's
+    drift centroid and contract against the bank rows the trial's
+    per-bin ``(z_k, w_k)`` quantised to.  Out-of-band taps contribute
+    zero (template edge, not wraparound)."""
+    half = (m - 1) // 2
+    joff = xp.arange(m, dtype=xp.int32) - half
+    cols = gidx_row[:, None].astype(xp.int32) + joff[None, :]
+    valid = (cols >= 0) & (cols < nbins)
+    window = xp.take(X, xp.clip(cols, 0, nbins - 1), axis=-1)
+    taps = xp.take(filt, tidx_row, axis=0) * valid.astype(filt.dtype)
+    return xp.einsum("dkj,kj->dk", window, taps)
+
+
+def _score_one(X, filt, gidx_row, tidx_row, nsamples, tsamp,
+               max_harmonics, fmin, fmax, xp):
+    """One trial: correlate, square, normalise, score — the scoring
+    half is the shared implementation, so every backend ranks with the
+    same statistic."""
+    nbins = X.shape[-1]
+    m = filt.shape[-1]
+    y = _correlate_one(X, filt, gidx_row, tidx_row, nbins, m, xp)
+    power = (xp.abs(y) ** 2) * _dc_mask(nbins, xp)
+    power = normalize_power(power, xp=xp)
+    return score_normalized_power(power, nsamples, tsamp,
+                                  max_harmonics=max_harmonics,
+                                  fmin=fmin, fmax=fmax, xp=xp)
+
+
+@counted_plan_cache("period_fdas", maxsize=PLAN_CACHE_SIZE)
+def _fdas_program(tsamp, ndm, nsamples, nbins_c, ntrials, m, max_harmonics,
+                  fmin, fmax, topk):
+    """ONE jitted program for the whole fdas sweep: a single batched
+    rFFT of the plane (sliced to the ``nbins_c`` prefix the band
+    needs), then ``lax.map`` over trials (one trial's gather window +
+    correlation workspace live at a time), device-side top-k over the
+    flattened sigma grid."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(plane, filt, gidx, tidx):
+        spec = jnp.fft.rfft(plane, axis=-1)[:, :nbins_c]
+
+        def one(args):
+            g, t = args
+            res = _score_one(spec, filt, g, t, nsamples, tsamp,
+                             max_harmonics, fmin, fmax, jnp)
+            return jnp.stack([res[k].astype(jnp.float32)
+                              for k in _SPEC_KEYS])
+
+        stacked = jax.lax.map(one, (gidx, tidx))   # (ntrials, 5, ndm)
+        sigma = stacked[:, _SPEC_KEYS.index("sigma"), :].reshape(-1)
+        k = min(int(topk), ntrials * ndm)
+        _vals, flat_idx = jax.lax.top_k(sigma, k)
+        return stacked, flat_idx
+
+    return run
+
+
+@counted_plan_cache("period_fdas_mesh", maxsize=PLAN_CACHE_SIZE)
+def _fdas_program_sharded(mesh, tsamp, ndm_pad, nsamples, nbins_c,
+                          ntrials_pad, m, max_harmonics, fmin, fmax):
+    """The fdas sweep sharded over the existing mesh: DM rows on the
+    ``dm`` axis, trial blocks on the ``chan`` axis (the
+    ``_accel_program_sharded`` layout); each device transforms its DM
+    block once, correlates its trial block, and only the per-trial
+    score vectors leave the devices.  The template bank is replicated
+    — it is ``nbank * m`` complex64, tiny next to the plane."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    def local(plane_local, filt, gidx_local, tidx_local):
+        spec = jnp.fft.rfft(plane_local, axis=-1)[:, :nbins_c]
+
+        def one(args):
+            g, t = args
+            res = _score_one(spec, filt, g, t, nsamples, tsamp,
+                             max_harmonics, fmin, fmax, jnp)
+            return jnp.stack([res[k].astype(jnp.float32)
+                              for k in _SPEC_KEYS])
+
+        return jax.lax.map(one, (gidx_local, tidx_local))
+
+    fn = shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(P("dm", None), P(None, None), P("chan", None),
+                  P("chan", None)),
+        out_specs=P("chan", None, "dm"))
+
+    @jax.jit
+    def run(plane, filt, gidx, tidx):
+        return fn(plane, filt, gidx, tidx)   # (ntrials_pad, 5, ndm_pad)
+
+    return run
+
+
+def fdas_search(plane, tsamp, accels, *, jerks=None, max_harmonics=16,
+                fmin=None, fmax=None, topk=32, xp=np, mesh=None):
+    """Fourier-domain search of the plane over the (DM, accel[, jerk])
+    grid — drop-in equivalent of :func:`.accel.accel_search` (same
+    trial ordering, same result-table layout, same top-k rule) that
+    transforms each DM row once instead of once per trial.
+
+    ``xp=numpy`` runs the host float64 reference; ``xp=jax.numpy`` the
+    single jitted program; ``mesh`` shards (DM, trial) over the
+    ``(dm, chan)`` mesh.  Host/jit/mesh tables agree cell for cell
+    (discrete fields exactly, sigma to float tolerance).
+    """
+    plane = np.asarray(plane, dtype=np.float32) if xp is np else plane
+    ndm, nsamples = np.shape(plane)
+    nbins = int(nsamples) // 2 + 1
+    accels = np.atleast_1d(np.asarray(accels, dtype=np.float64))
+    t_accels, t_jerks = trial_product(accels, jerks)
+    ntrials = len(t_accels)
+    lo = None if fmin is None else float(fmin)
+    hi = None if fmax is None else float(fmax)
+    nbins_c = _band_slice(nbins, nsamples, tsamp, hi, max_harmonics,
+                          t_accels, t_jerks)
+    tables = bank_for_trials(tuple(t_accels.tolist()),
+                             tuple(t_jerks.tolist()), nbins_c,
+                             float(tsamp), int(nsamples))
+    m = tables["bank"].shape[-1]
+
+    from ..obs import metrics
+    metrics.counter("putpu_fdas_bank_entries_total").inc(
+        int(tables["bank"].shape[0]))
+    metrics.counter("putpu_fdas_trials_total").inc(int(ntrials) * int(ndm))
+
+    if xp is np:
+        spec = np.fft.rfft(plane, axis=-1)[:, :nbins_c]  # host: complex128
+        filt = tables["bank"]
+        stacked = np.zeros((ntrials, 5, ndm), dtype=np.float64)
+        for a in range(ntrials):
+            res = _score_one(spec, filt, tables["gidx"][a],
+                             tables["tidx"][a], nsamples, tsamp,
+                             max_harmonics, lo, hi, np)
+            stacked[a] = np.stack([np.asarray(res[k], dtype=np.float64)
+                                   for k in _SPEC_KEYS])
+        flat_idx = _select_topk(stacked[:, _SPEC_KEYS.index("sigma"), :],
+                                topk)
+        return _result_table(stacked, flat_idx, accels, tsamp, nsamples,
+                             jerks=jerks)
+
+    import jax.numpy as jnp
+
+    filt_dev = jnp.asarray(tables["bank"], dtype=jnp.complex64)
+
+    if mesh is not None:
+        n_dm_shards = mesh.shape["dm"]
+        n_tr_shards = mesh.shape["chan"]
+        ndm_pad = -(-ndm // n_dm_shards) * n_dm_shards
+        ntr_pad = -(-ntrials // n_tr_shards) * n_tr_shards
+        plane_dev = jnp.asarray(plane, dtype=jnp.float32)
+        if ndm_pad != ndm:
+            plane_dev = jnp.pad(plane_dev, ((0, ndm_pad - ndm), (0, 0)))
+        gidx, tidx = tables["gidx"], tables["tidx"]
+        if ntr_pad != ntrials:
+            # pad with the (z=0, w=0) delta template rows; discarded
+            pad_g = np.arange(nbins_c, dtype=np.int32)[None, :]
+            pad_t = np.full((1, nbins_c), tables["zero_index"],
+                            dtype=np.int32)
+            reps = ntr_pad - ntrials
+            gidx = np.concatenate([gidx, np.repeat(pad_g, reps, axis=0)])
+            tidx = np.concatenate([tidx, np.repeat(pad_t, reps, axis=0)])
+        run = _fdas_program_sharded(mesh, float(tsamp), ndm_pad,
+                                    int(nsamples), int(nbins_c), ntr_pad,
+                                    int(m), int(max_harmonics), lo, hi)
+        stacked = np.asarray(run(plane_dev, filt_dev, jnp.asarray(gidx),
+                                 jnp.asarray(tidx)),
+                             dtype=np.float64)[:ntrials, :, :ndm]
+        flat_idx = _select_topk(stacked[:, _SPEC_KEYS.index("sigma"), :],
+                                topk)
+        return _result_table(stacked, flat_idx, accels, tsamp, nsamples,
+                             jerks=jerks)
+
+    run = _fdas_program(float(tsamp), int(ndm), int(nsamples),
+                        int(nbins_c), int(ntrials), int(m),
+                        int(max_harmonics), lo, hi, int(topk))
+    stacked, flat_idx = run(jnp.asarray(plane, dtype=jnp.float32),
+                            filt_dev, jnp.asarray(tables["gidx"]),
+                            jnp.asarray(tables["tidx"]))
+    return _result_table(np.asarray(stacked, dtype=np.float64),
+                         np.asarray(flat_idx), accels, tsamp, nsamples,
+                         jerks=jerks)

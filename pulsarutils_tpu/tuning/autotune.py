@@ -66,9 +66,10 @@ from .geometry import dtype_name, geometry_key
 
 __all__ = ["KernelTuner", "get_tuner", "set_tuner", "autotune_mode",
            "static_search_kernel", "static_mesh_kernel", "hits_match",
-           "measure_kernel_wall", "resolve_search_kernel",
-           "resolve_mesh_kernel", "resolve_batched_kernel",
-           "decision_seq", "decisions_since",
+           "accel_tables_match", "measure_kernel_wall",
+           "resolve_search_kernel", "resolve_mesh_kernel",
+           "resolve_batched_kernel", "resolve_accel_backend",
+           "decision_seq", "decisions_since", "ACCEL_SIGMA_RTOL",
            "MIN_TUNE_ELEMENTS", "TUNE_REPS", "TUNE_PROBE_TRIALS"]
 
 #: timed repetitions per candidate (median taken); the warm-up
@@ -355,7 +356,8 @@ class KernelTuner:
     # -- resolution ----------------------------------------------------------
 
     def resolve(self, *, backend, nchan, nsamples, ndm, dtype, candidates,
-                static, runner_factory=None, mesh_shape=None, batch=1):
+                static, runner_factory=None, mesh_shape=None, batch=1,
+                equiv=None):
         """One kernel name for this geometry.
 
         ``candidates`` is the constraint-filtered variant list (static
@@ -365,7 +367,11 @@ class KernelTuner:
         the beam-batch width of the multi-beam stacked dispatch (1 =
         the classic single-beam search; the key — and therefore the
         measured winner — is batch-specific, see
-        :func:`~.geometry.geometry_key`).
+        :func:`~.geometry.geometry_key`).  ``equiv`` overrides the
+        equivalence harness (``equiv(ref_scores, cand_scores) ->
+        bool``; default :func:`hits_match`) — contender pairs whose
+        score packs are tables rather than hit tuples supply their own
+        matcher (``resolve_accel_backend``).
         """
         from ..obs import metrics as _metrics
 
@@ -414,7 +420,8 @@ class KernelTuner:
             return self._decide(key, static, "static", static,
                                 reason="no measurement runner")
         try:
-            return self._measure(key, candidates, static, runner_factory)
+            return self._measure(key, candidates, static, runner_factory,
+                                 equiv=equiv)
         except Exception as exc:  # putpu-lint: disable=broad-except — tuning must degrade to static, never fail a search
             logger.warning("autotune measurement failed for %s (%r); "
                            "using the static heuristic", key, exc)
@@ -422,12 +429,14 @@ class KernelTuner:
                                 reason=f"measurement failed: "
                                        f"{type(exc).__name__}")
 
-    def _measure(self, key, candidates, static, runner_factory):
+    def _measure(self, key, candidates, static, runner_factory,
+                 equiv=None):
         """Warm up, fence, median-of-k each candidate; gate equivalence;
         cache and return the winner."""
         from ..obs import metrics as _metrics
         from ..obs.trace import span
 
+        matcher = equiv if equiv is not None else hits_match
         measurer = self.measurer or measure_kernel_wall
         with self._lock:  # one measurement per key, ever
             hit = self._resolved.get(key)
@@ -450,7 +459,7 @@ class KernelTuner:
                         scores = run()  # warm-up: compile excluded
                         if cand == static:
                             ref_scores = scores
-                        elif not hits_match(ref_scores, scores):
+                        elif not matcher(ref_scores, scores):
                             _metrics.counter(
                                 "putpu_autotune_equiv_rejected_total").inc()
                             logger.warning(
@@ -689,3 +698,147 @@ def resolve_mesh_kernel(mesh, nchan, nsamples, ndm, start_freq, bandwidth,
         dtype=dtype_name(None if f32 else dtype), candidates=candidates,
         static=static, runner_factory=runner_factory,
         mesh_shape=mesh_shape)
+
+
+# ---------------------------------------------------------------------------
+# the periodicity accel-backend contender pair (time_stretch vs fdas)
+# ---------------------------------------------------------------------------
+
+#: cross-backend sigma tolerance for the accel-backend harness.  The
+#: two formulations window the signal differently — integer-sample
+#: stretch resampling scallops power by ~sinc^2(f0*tsamp) where the
+#: truncated z/w-response template clips a few percent of template
+#: energy — so bit-exact sigma equality ACROSS backends is not a
+#: theorem (within a backend, host/jit/mesh stay cell-for-cell
+#: identical).  The discrete cell identity IS a theorem at matched
+#: trial grids, and that is what the harness pins exactly.
+ACCEL_SIGMA_RTOL = 0.12
+
+
+def accel_tables_match(ref, cand, rtol=ACCEL_SIGMA_RTOL):
+    """The PR 7 rule restated for periodicity trial tables.
+
+    ``ref``/``cand`` are top-k candidate tables over the same probe
+    trial grid (rows ranked best-first).  Equivalent means: the top
+    candidate's discrete cell — DM row, acceleration/jerk trial index,
+    harmonic depth — agrees EXACTLY, its frequency lands on the same
+    Fourier bin, and its sigma agrees within ``rtol``
+    (:data:`ACCEL_SIGMA_RTOL`).  A backend failing this is rejected
+    from tuning regardless of how fast it measured: the tuner may
+    change speed, never hits.
+    """
+    if ref is None or cand is None:
+        return False
+    try:
+        if (len(np.asarray(ref["sigma"])) == 0
+                or len(np.asarray(cand["sigma"])) == 0):
+            return False
+        for col in ("dm_index", "accel_index", "jerk_index", "nharm"):
+            if col in ref and col in cand and (
+                    int(np.asarray(ref[col])[0])
+                    != int(np.asarray(cand[col])[0])):
+                return False
+        if not np.isclose(float(np.asarray(cand["freq"])[0]),
+                          float(np.asarray(ref["freq"])[0]),
+                          rtol=1e-5, atol=0.0):
+            return False
+        return bool(np.isclose(float(np.asarray(cand["sigma"])[0]),
+                               float(np.asarray(ref["sigma"])[0]),
+                               rtol=float(rtol), atol=1e-2))
+    except (KeyError, IndexError, TypeError, ValueError):
+        return False
+
+
+def synthetic_accel_plane(ndm, nsamples, tsamp, accel, jerk=0.0,
+                          amp=0.6, seed=1601):
+    """Seeded noise plane + one accelerated sinusoid on a probe trial.
+
+    The injection row is ``ndm // 3`` (the canary convention) and the
+    phase model is the time-stretch backend's own —
+    ``phi = f0*(t + a*t^2/(2c) + j*t^3/(6c))`` — with ``f0`` placed on
+    an exact Fourier bin well below Nyquist (scalloping and template
+    truncation both stay small there), so both backends must put their
+    top cell on the injection: the decisive comparison
+    :func:`accel_tables_match` makes.
+    """
+    from ..periodicity.accel import C_M_S
+
+    rng = np.random.default_rng(seed)
+    plane = rng.standard_normal((int(ndm), int(nsamples)))
+    k0 = max(int(round(0.175 * int(nsamples))), 4)
+    f0 = k0 / (int(nsamples) * float(tsamp))
+    t = np.arange(int(nsamples)) * float(tsamp)
+    phase = f0 * (t + float(accel) * t * t / (2.0 * C_M_S)
+                  + float(jerk) * t ** 3 / (6.0 * C_M_S))
+    plane[int(ndm) // 3] += amp * np.sin(2.0 * np.pi * phase)
+    return plane
+
+
+def resolve_accel_backend(ndm, nsamples, tsamp, accels, jerks=None,
+                          max_harmonics=16, fmin=None, fmax=None,
+                          mesh=None):
+    """``accel_backend="auto"`` resolution for the periodicity sweep.
+
+    Candidates: ``"time_stretch"`` (PR 12's stretch-resample + one
+    rfft per trial) vs ``"fdas"`` (one rfft per DM + batched
+    z/w-response correlation, :mod:`~pulsarutils_tpu.periodicity.
+    fdas`).  The static choice is ``time_stretch`` — the proven PR 12
+    path — so below-floor geometries (every tier-1 test: the
+    documented contract) resolve to it with zero side effects; above
+    the floor the winner is platform-dependent (arxiv 1601.01165), so
+    it is measured over a synthetic accelerated-pulsar plane,
+    equivalence-gated by :func:`accel_tables_match` and cached per
+    geometry.  The key maps ``nchan=ndm`` (plane rows stand where
+    channels do) and ``ndm=ntrials``, under a ``"-accel"`` backend
+    suffix so a periodicity decision can never collide with a
+    single-pulse kernel entry of the same shape.
+
+    The probe slices the trial grid exactly as the DM probe does —
+    evenly — so probe spacing is coarser than the survey grid and the
+    injected cell is non-degenerate at the injection frequency.
+    """
+    import jax
+
+    backend = jax.default_backend()
+    static = "time_stretch"
+    candidates = [static, "fdas"]
+    ntrials = int(len(accels)) * (int(len(jerks))
+                                  if jerks is not None else 1)
+    mesh_shape = (tuple(int(mesh.shape[a]) for a in mesh.shape)
+                  if mesh is not None else None)
+
+    def runner_factory():
+        import jax.numpy as jnp
+
+        from ..periodicity.accel import accel_search
+        from ..periodicity.fdas import fdas_search
+
+        tuner = get_tuner()
+        sub_acc = _probe_grid(accels, tuner.probe_trials)
+        sub_jerks = (_probe_grid(jerks, 5)
+                     if jerks is not None and len(jerks) > 1 else None)
+        inj_a = float(  # putpu-lint: disable=device-trip — host trial grid
+            sub_acc[(3 * len(sub_acc)) // 4])
+        inj_j = (float(  # putpu-lint: disable=device-trip — host trial grid
+            sub_jerks[(3 * len(sub_jerks)) // 4])
+            if sub_jerks is not None else 0.0)
+        plane = synthetic_accel_plane(ndm, nsamples, tsamp, inj_a,
+                                      jerk=inj_j)
+        kw = dict(jerks=sub_jerks, max_harmonics=max_harmonics,
+                  fmin=fmin, fmax=fmax, topk=8, xp=jnp, mesh=mesh)
+
+        def make(search):
+            def run():
+                table = search(plane, tsamp, sub_acc, **kw)
+                return {k: np.asarray(v) for k, v in table.items()}
+            return run
+
+        return {"time_stretch": make(accel_search),
+                "fdas": make(fdas_search)}
+
+    return get_tuner().resolve(
+        backend=f"{backend}-accel", nchan=int(ndm),
+        nsamples=int(nsamples), ndm=ntrials, dtype=dtype_name(None),
+        candidates=candidates, static=static,
+        runner_factory=runner_factory, mesh_shape=mesh_shape,
+        equiv=accel_tables_match)

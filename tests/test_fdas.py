@@ -1,0 +1,575 @@
+"""Fourier-domain acceleration/jerk search (ISSUE 16): z/w-response
+template accuracy against quadrature and the time-domain stretch
+oracle, the grid-cap telemetry, fdas host/jit/mesh cell-for-cell
+identity, the measured accel-backend autotuner pair, and the
+jerk-axis plumbing through the driver, service intake and fleet."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+from pulsarutils_tpu.models.simulate import simulate_accel_pulsar_data
+from pulsarutils_tpu.obs.metrics import REGISTRY
+from pulsarutils_tpu.ops import zresponse
+from pulsarutils_tpu.ops.zresponse import (MAX_HALF_WIDTH, Z_SMALL,
+                                           bank_for_trials, fresnel,
+                                           z_response, zw_response)
+from pulsarutils_tpu.periodicity.accel import (C_M_S, accel_grid,
+                                               accel_search, jerk_grid,
+                                               trial_product)
+from pulsarutils_tpu.periodicity.driver import periodicity_search
+from pulsarutils_tpu.periodicity.fdas import fdas_search
+from pulsarutils_tpu.tuning import autotune
+from pulsarutils_tpu.tuning.cache import TuneCache
+
+TSAMP = 0.0005
+NSAMPLES = 16384
+NDM = 6
+#: the injected tone sits exactly on Fourier bin K0 (~350 Hz) — high
+#: enough that the accel/jerk grids below are non-degenerate (z ~ 19,
+#: w ~ 40 bins at the grid edges), low enough that the stretch
+#: backend's resampling scalloping stays small
+K0 = int(round(0.175 * NSAMPLES))
+F0 = K0 / (NSAMPLES * TSAMP)
+ACCELS = np.linspace(-2.0e5, 2.0e5, 9)
+JERKS = np.linspace(-5.0e4, 5.0e4, 5)
+#: synthetic_accel_plane injects at DM row ndm // 3
+INJ_DM, INJ_A, INJ_J = NDM // 3, 6, 3
+KW = dict(jerks=JERKS, max_harmonics=1, fmax=1.25 * F0, topk=8)
+
+
+def _counter(name, **labels):
+    for rec in REGISTRY.snapshot():
+        if rec["name"] == name and rec.get("labels", {}) == labels:
+            return rec["value"]
+    return 0
+
+
+@pytest.fixture(scope="module")
+def plane():
+    return autotune.synthetic_accel_plane(
+        NDM, NSAMPLES, TSAMP, ACCELS[INJ_A], jerk=JERKS[INJ_J])
+
+
+@pytest.fixture(scope="module")
+def host_tables(plane):
+    """(time_stretch, fdas) host-float64 reference tables of the same
+    injected plane — the cross-backend oracle pair."""
+    t_stretch = accel_search(plane, TSAMP, ACCELS, xp=np, **KW)
+    t_fdas = fdas_search(plane, TSAMP, ACCELS, xp=np, **KW)
+    return t_stretch, t_fdas
+
+
+# ---------------------------------------------------------------------------
+# Fresnel integrals (no scipy in this repo: series + asymptotic branch)
+# ---------------------------------------------------------------------------
+
+_trapz = getattr(np, "trapezoid", np.trapz)
+
+
+def _fresnel_reference(x, n=400_001):
+    t = np.linspace(0.0, float(x), n)
+    arg = 0.5 * np.pi * t * t
+    return _trapz(np.cos(arg), t), _trapz(np.sin(arg), t)
+
+
+class TestFresnel:
+    def test_accuracy_against_quadrature(self):
+        # straddle the series/asymptotic split (3.2) on purpose
+        for x in (0.3, 1.7, 3.19, 3.2, 3.21, 5.0, 8.0):
+            c_ref, s_ref = _fresnel_reference(x)
+            c, s = fresnel(x)
+            assert c == pytest.approx(c_ref, abs=1e-6), x
+            assert s == pytest.approx(s_ref, abs=1e-6), x
+
+    def test_odd_symmetry_and_large_x_limit(self):
+        x = np.array([-6.0, -2.0, -0.5, 0.0, 0.5, 2.0, 6.0])
+        c, s = fresnel(x)
+        np.testing.assert_allclose(c, -c[::-1], atol=1e-15)
+        np.testing.assert_allclose(s, -s[::-1], atol=1e-15)
+        assert c[3] == 0.0 and s[3] == 0.0
+        # C, S -> 1/2 with an O(1/x) oscillatory tail
+        c_inf, s_inf = fresnel(500.0)
+        assert abs(c_inf - 0.5) < 1.0 / (np.pi * 500.0)
+        assert abs(s_inf - 0.5) < 1.0 / (np.pi * 500.0)
+
+
+# ---------------------------------------------------------------------------
+# z/w responses: closed form vs sampled chirp, branch seams, the bank
+# ---------------------------------------------------------------------------
+
+class TestResponses:
+    def test_speed_of_light_pinned_to_accel_module(self):
+        # the ops layer cannot import upward, so the constant is
+        # duplicated — this pin is the documented substitute
+        assert zresponse._C_M_S == C_M_S
+
+    def test_zero_drift_response_is_a_delta(self):
+        q = np.arange(-4, 5, dtype=np.float64)
+        a = z_response(0.0, q)
+        assert abs(a[4]) == pytest.approx(1.0, abs=1e-12)
+        off = np.abs(np.delete(a, 4))
+        assert off.max() < 1e-12          # sinc is exactly 0 at ints
+
+    def test_closed_form_matches_sampled_chirp(self):
+        # the w=0 Fresnel closed form against the numerical FFT path
+        # (the doc'd seam property), spanning BOTH closed-form regimes
+        # and the small-|z| series branch
+        q = np.arange(-20, 21)
+        for z in (5.0e-4, 2.0e-3, 5.0, 37.3):
+            a_closed = z_response(z, q.astype(np.float64))
+            a_chirp = zw_response(z, 0.0, q)
+            np.testing.assert_allclose(a_closed, a_chirp, atol=5e-4,
+                                       err_msg=f"z={z}")
+
+    def test_small_z_branch_is_continuous(self):
+        q = np.arange(-10, 11, dtype=np.float64)
+        below = z_response(Z_SMALL * 0.999, q)
+        above = z_response(Z_SMALL * 1.001, q)
+        np.testing.assert_allclose(below, above, atol=1e-4)
+        # and the negative-z conjugate symmetry across the seam too
+        np.testing.assert_allclose(z_response(-Z_SMALL * 1.001, q),
+                                   np.conj(z_response(Z_SMALL * 1.001,
+                                                      -q)), atol=1e-12)
+
+    def test_zw_response_rejects_fractional_bins(self):
+        with pytest.raises(ValueError, match="integer"):
+            zw_response(3.0, 10.0, np.array([0.5]))
+
+    def test_bank_zero_trial_is_delta_row(self):
+        tab = bank_for_trials((0.0,), (0.0,), 64, TSAMP, NSAMPLES)
+        row = tab["bank"][tab["zero_index"]]
+        h = tab["half_width"]
+        assert np.argmax(np.abs(row)) == h
+        assert abs(row[h]) == pytest.approx(1.0, abs=1e-12)
+        np.testing.assert_array_equal(tab["centers"], [0])
+        # gather origins are the spectrum bins themselves
+        np.testing.assert_array_equal(tab["gidx"][0], np.arange(64))
+
+    def test_bank_half_width_cap_warns(self):
+        with pytest.warns(UserWarning, match="half-width"):
+            tab = bank_for_trials((5.0e6,), (0.0,), 8193, TSAMP,
+                                  NSAMPLES)
+        assert tab["half_width"] == MAX_HALF_WIDTH
+
+
+# ---------------------------------------------------------------------------
+# trial grids: physics spacing, the warn+count cap, accel-major order
+# ---------------------------------------------------------------------------
+
+class TestGrids:
+    def test_jerk_grid_properties(self):
+        g = jerk_grid(1.0e5, TSAMP, NSAMPLES)
+        assert g[0] == -1.0e5 and g[-1] == 1.0e5
+        assert 0.0 in g and g.size % 2 == 1
+        np.testing.assert_allclose(g, -g[::-1])
+        assert jerk_grid(0.0, TSAMP, NSAMPLES).tolist() == [0.0]
+        assert jerk_grid(-1.0, TSAMP, NSAMPLES).tolist() == [0.0]
+
+    def test_grid_caps_warn_and_count(self):
+        # the no-silent-caps satellite: a binding max_trials is a
+        # warning plus a putpu_period_grid_capped_total tick per axis
+        a0 = _counter("putpu_period_grid_capped_total", axis="accel")
+        with pytest.warns(UserWarning, match="max_trials"):
+            g = accel_grid(1.0e9, 0.001, 1 << 16, max_trials=11)
+        assert g.size == 11 and 0.0 in g
+        assert _counter("putpu_period_grid_capped_total",
+                        axis="accel") == a0 + 1
+        j0 = _counter("putpu_period_grid_capped_total", axis="jerk")
+        with pytest.warns(UserWarning, match="max_trials"):
+            gj = jerk_grid(1.0e9, 0.001, 1 << 16, max_trials=11)
+        assert gj.size == 11 and 0.0 in gj
+        assert _counter("putpu_period_grid_capped_total",
+                        axis="jerk") == j0 + 1
+
+    def test_trial_product_is_accel_major(self):
+        ta, tj = trial_product(np.array([1.0, 2.0]),
+                               np.array([10.0, 20.0, 30.0]))
+        assert ta.tolist() == [1.0, 1.0, 1.0, 2.0, 2.0, 2.0]
+        assert tj.tolist() == [10.0, 20.0, 30.0, 10.0, 20.0, 30.0]
+        ta0, tj0 = trial_product(np.array([1.0, 2.0]), None)
+        assert ta0.tolist() == [1.0, 2.0] and tj0.tolist() == [0.0, 0.0]
+
+
+# ---------------------------------------------------------------------------
+# the oracle: fdas vs time-domain stretch on the injected plane
+# ---------------------------------------------------------------------------
+
+class TestOracle:
+    def test_both_backends_recover_the_injected_cell(self, host_tables):
+        for name, tbl in zip(("time_stretch", "fdas"), host_tables):
+            assert int(tbl["dm_index"][0]) == INJ_DM, name
+            assert int(tbl["accel_index"][0]) == INJ_A, name
+            assert int(tbl["jerk_index"][0]) == INJ_J, name
+            assert abs(int(tbl["freq_bin"][0]) - K0) <= 1, name
+
+    def test_cross_backend_tables_match(self, host_tables):
+        # the autotuner's own equivalence contract, asserted directly:
+        # discrete fields of the top cell exact, sigma to a few percent
+        t_stretch, t_fdas = host_tables
+        assert autotune.accel_tables_match(t_stretch, t_fdas)
+        assert np.isclose(float(t_fdas["sigma"][0]),
+                          float(t_stretch["sigma"][0]),
+                          rtol=autotune.ACCEL_SIGMA_RTOL)
+
+    def test_zero_trial_is_plain_spectral_scoring(self, plane):
+        # accels=[0] means the delta template: the fdas correlation is
+        # the raw spectrum and both formulations reduce to the same
+        # spectral scoring, float64-exactly
+        kw = dict(max_harmonics=4, fmin=4.0 / (NSAMPLES * TSAMP),
+                  topk=8, xp=np)
+        p32 = np.asarray(plane, dtype=np.float32)  # both paths see the
+        t_f = fdas_search(p32, TSAMP, [0.0], **kw)  # same input values
+        t_s = accel_search(p32, TSAMP, [0.0], **kw)
+        for k in ("dm_index", "accel_index", "jerk_index", "freq_bin",
+                  "nharm"):
+            np.testing.assert_array_equal(t_f[k], t_s[k], err_msg=k)
+        # the two host paths round intermediates differently at the
+        # float32 level (the stretch path mirrors the device program's
+        # dtype discipline) — discrete fields exact, floats to the
+        # repo-wide float tolerance
+        for k in ("freq", "power", "log_sf", "sigma"):
+            np.testing.assert_allclose(t_f[k], t_s[k], rtol=1e-6,
+                                       atol=1e-6, err_msg=k)
+
+    def test_fdas_metrics_tick(self, plane):
+        t0 = _counter("putpu_fdas_trials_total")
+        b0 = _counter("putpu_fdas_bank_entries_total")
+        fdas_search(plane[:2], TSAMP, np.array([0.0, ACCELS[INJ_A]]),
+                    max_harmonics=1, fmax=1.25 * F0, topk=4, xp=np)
+        assert _counter("putpu_fdas_trials_total") == t0 + 4
+        assert _counter("putpu_fdas_bank_entries_total") > b0
+
+
+# ---------------------------------------------------------------------------
+# execution-path identity: host / jit / (4,2) and (2,4) meshes
+# ---------------------------------------------------------------------------
+
+def _assert_tables_identical(tables, ref):
+    for name, tbl in tables.items():
+        for k in ("dm_index", "accel_index", "jerk_index", "freq_bin",
+                  "nharm"):
+            np.testing.assert_array_equal(
+                tbl[k], ref[k], err_msg=f"{name} diverges on {k}")
+        np.testing.assert_allclose(tbl["sigma"], ref["sigma"],
+                                   rtol=5e-3, atol=5e-3, err_msg=name)
+
+
+class TestPathIdentity:
+    def test_fdas_host_jit_mesh_tables_identical(self, plane,
+                                                 host_tables):
+        from pulsarutils_tpu.parallel.mesh import make_mesh
+
+        _, t_np = host_tables
+        t_jit = fdas_search(plane, TSAMP, ACCELS, xp=jnp, **KW)
+        tables = {"np": t_np}
+        for shape in [(4, 2), (2, 4)]:
+            mesh = make_mesh(shape, ("dm", "chan"))
+            tables[f"mesh{shape}"] = fdas_search(
+                plane, TSAMP, ACCELS, xp=jnp, mesh=mesh, **KW)
+        _assert_tables_identical(tables, t_jit)
+
+    def test_stretch_jerk_host_jit_mesh_identical(self, plane,
+                                                  host_tables):
+        from pulsarutils_tpu.parallel.mesh import make_mesh
+
+        t_np, _ = host_tables
+        t_jit = accel_search(plane, TSAMP, ACCELS, xp=jnp, **KW)
+        mesh = make_mesh((4, 2), ("dm", "chan"))
+        t_mesh = accel_search(plane, TSAMP, ACCELS, xp=jnp, mesh=mesh,
+                              **KW)
+        _assert_tables_identical({"np": t_np, "mesh": t_mesh}, t_jit)
+
+
+# ---------------------------------------------------------------------------
+# the measured accel-backend pair
+# ---------------------------------------------------------------------------
+
+def _match_table(sigma=30.0, accel_index=6, jerk_index=3, freq=350.0):
+    return {"dm_index": np.array([2]), "accel_index":
+            np.array([accel_index]), "jerk_index": np.array([jerk_index]),
+            "nharm": np.array([1]), "freq": np.array([freq]),
+            "sigma": np.array([sigma])}
+
+
+class TestBackendTuning:
+    @pytest.fixture(autouse=True)
+    def _hermetic_tuner(self, monkeypatch):
+        monkeypatch.delenv("PUTPU_AUTOTUNE", raising=False)
+        monkeypatch.delenv("PUTPU_AUTOTUNE_MIN", raising=False)
+        prev = autotune.set_tuner(
+            autotune.KernelTuner(cache=TuneCache(None)))
+        yield
+        autotune.set_tuner(prev)
+
+    def test_accel_tables_match_rules(self):
+        ref = _match_table()
+        assert not autotune.accel_tables_match(None, ref)
+        assert not autotune.accel_tables_match(ref, None)
+        empty = {k: v[:0] for k, v in ref.items()}
+        assert not autotune.accel_tables_match(ref, empty)
+        assert autotune.accel_tables_match(ref, _match_table(sigma=31.0))
+        assert not autotune.accel_tables_match(
+            ref, _match_table(accel_index=5))
+        assert not autotune.accel_tables_match(
+            ref, _match_table(jerk_index=2))
+        assert not autotune.accel_tables_match(ref,
+                                               _match_table(sigma=45.0))
+        assert not autotune.accel_tables_match(ref,
+                                               _match_table(freq=351.0))
+
+    def test_below_floor_resolves_to_time_stretch(self):
+        # the default 2^25-element floor: every tier-1-scale geometry
+        # resolves statically with zero measurements
+        mark = autotune.decision_seq()
+        got = autotune.resolve_accel_backend(
+            NDM, NSAMPLES, TSAMP, ACCELS, jerks=JERKS, max_harmonics=1,
+            fmax=1.25 * F0)
+        assert got == "time_stretch"
+        (dec,) = autotune.decisions_since(mark)
+        assert dec["source"] == "static" and "floor" in dec["reason"]
+
+    def test_forced_floor_measures_the_pair_once(self):
+        autotune.set_tuner(autotune.KernelTuner(
+            cache=TuneCache(None), mode="on", min_elements=0, reps=1))
+        mark = autotune.decision_seq()
+        kw = dict(jerks=JERKS, max_harmonics=1, fmax=1.25 * F0)
+        got = autotune.resolve_accel_backend(NDM, NSAMPLES, TSAMP,
+                                             ACCELS, **kw)
+        assert got in ("time_stretch", "fdas")
+        (dec,) = autotune.decisions_since(mark)
+        assert dec["kernel"] == got and dec["source"] == "measured"
+        # the "-accel" backend suffix keeps the key from colliding
+        # with a single-pulse kernel entry of the same shape
+        assert "-accel|" in dec["key"]
+        # second resolve at the same geometry: memory hit, no decision
+        mark = autotune.decision_seq()
+        assert autotune.resolve_accel_backend(NDM, NSAMPLES, TSAMP,
+                                              ACCELS, **kw) == got
+        assert autotune.decisions_since(mark) == []
+
+    def test_resolve_equiv_override_gates_candidates(self):
+        # the generic harness: a caller-supplied equivalence matcher
+        # replaces hits_match and an inequivalent-but-faster candidate
+        # is rejected
+        def measurer(kernel, run, reps):
+            return {"a": 0.4, "b": 0.001}[kernel]
+
+        tuner = autotune.KernelTuner(cache=TuneCache(None), mode="on",
+                                     min_elements=0, measurer=measurer)
+        runners = {"a": lambda: {"tag": "a"}, "b": lambda: {"tag": "b"}}
+        got = tuner.resolve(backend="cpu", nchan=4, nsamples=4, ndm=4,
+                            dtype="float32", candidates=["a", "b"],
+                            static="a", runner_factory=lambda: runners,
+                            equiv=lambda ref, cand:
+                                cand["tag"] == ref["tag"])
+        assert got == "a"
+
+
+# ---------------------------------------------------------------------------
+# end to end: the jerk-enabled sweep through the driver, resume, fleet
+# ---------------------------------------------------------------------------
+
+E2E_TSAMP, E2E_NSAMPLES, E2E_NCHAN = 0.0005, 16384, 32
+E2E_DM = 150.0
+E2E_F0 = 492 / (E2E_NSAMPLES * E2E_TSAMP)
+E2E_ACCEL, E2E_ACCEL_MAX = 4.5e5, 9.0e5
+#: ~48 Fourier bins of quadratic drift at E2E_F0 — the zero-jerk trial
+#: demonstrably smears it, and the accel span is narrow enough that no
+#: (accel, 0) cell can linearly compensate the cubic track (the
+#: accel/jerk degeneracy: a wide accel grid offers a quadratic that
+#: fits the cubic to within a fraction of a cycle).  The injected jerk
+#: sits exactly on grid index 3 of linspace(-E2E_JERK_MAX,
+#: E2E_JERK_MAX, 5)
+E2E_JERK, E2E_JERK_MAX = 4.4e5, 8.8e5
+E2E_JOB = dict(dmmin=130.0, dmmax=170.0, accel_max=E2E_ACCEL_MAX,
+               n_accel=5, jerk_max=E2E_JERK_MAX, n_jerk=5,
+               sigma_threshold=8.0, chunk_length=4096 * E2E_TSAMP,
+               snr_threshold=8.0, progress=False)
+
+
+@pytest.fixture(scope="module")
+def jerk_pulsar_file(tmp_path_factory):
+    """Binary pulsar with line-of-sight jerk: phase(t) = f0 (t +
+    a t^2 / 2c + j t^3 / 6c)."""
+    arr, hdr = simulate_accel_pulsar_data(
+        freq=E2E_F0, dm=E2E_DM, accel=E2E_ACCEL, jerk=E2E_JERK,
+        tsamp=E2E_TSAMP, nsamples=E2E_NSAMPLES, nchan=E2E_NCHAN, rng=17)
+    path = tmp_path_factory.mktemp("jerkpsr") / "jerky.fil"
+    write_simulated_filterbank(str(path), arr, hdr, descending=True)
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def jerk_run(jerk_pulsar_file, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("jerk_direct"))
+    res = periodicity_search(jerk_pulsar_file, output_dir=out, **E2E_JOB)
+    assert res["complete"]
+    return res
+
+
+class TestJerkEndToEnd:
+    def test_sweep_recovers_injected_jerk_cell(self, jerk_run):
+        assert len(jerk_run["jerks"]) == 5
+        assert jerk_run["accel_backend"] in ("time_stretch", "fdas")
+        cands = jerk_run["candidates"]
+        assert cands, "no candidates above threshold"
+        best = cands[0]
+        assert abs(best["dm"] - E2E_DM) < 5.0
+        assert best["accel"] == E2E_ACCEL      # exact grid cell
+        assert best["jerk"] == E2E_JERK
+        assert abs(best["freq_bin"] - 492) <= 1
+        assert best["sigma"] > 15.0
+        # the jerk axis demonstrably mattered: the best zero-jerk cell
+        # leaves ~24 bins of quadratic smear on the table
+        tbl = jerk_run["table"]
+        zero = [s for s, j in zip(tbl["sigma"], tbl["jerk"]) if j == 0.0]
+        assert not zero or max(zero) < best["sigma"]
+
+    def test_resume_rewrites_identical_candidates(self, jerk_run,
+                                                  jerk_pulsar_file):
+        # PR 15 resume semantics with the jerk axis on: the second run
+        # restores the snapshot + ledger and re-emits the candidates
+        # artifact with identical contents (array for array)
+        def arrays(path):
+            with np.load(path, allow_pickle=False) as d:
+                return {k: d[k].tobytes() for k in d.files}
+
+        first = arrays(jerk_run["candidates_path"])
+        out = os.path.dirname(jerk_run["candidates_path"])
+        res2 = periodicity_search(jerk_pulsar_file, output_dir=out,
+                                  **E2E_JOB)
+        assert res2["complete"]
+        assert res2["fingerprint"] == jerk_run["fingerprint"]
+        assert res2["candidates_path"] == jerk_run["candidates_path"]
+        second = arrays(res2["candidates_path"])
+        assert set(second) == set(first)
+        for k in first:
+            assert second[k] == first[k], f"{k} bytes differ on resume"
+
+    def test_jerkless_fingerprint_unchanged(self, jerk_pulsar_file,
+                                            tmp_path):
+        # the driver-fingerprint rule: jerk_max=0 must not enter the
+        # fingerprint extra, so pre-jerk ledgers/artifacts keep their
+        # names and remain resumable
+        from pulsarutils_tpu.pipeline.search_pipeline import plan_survey
+
+        base = plan_survey(jerk_pulsar_file, dmmin=130.0, dmmax=170.0,
+                           snr_threshold=8.0,
+                           chunk_length=4096 * E2E_TSAMP,
+                           fingerprint_extra={"workload": "periodicity",
+                                              "accel_max":
+                                              E2E_ACCEL_MAX})
+        res = periodicity_search(jerk_pulsar_file, 130.0, 170.0,
+                                 accel_max=E2E_ACCEL_MAX, n_accel=3,
+                                 jerk_max=0.0, sigma_threshold=8.0,
+                                 chunk_length=4096 * E2E_TSAMP,
+                                 snr_threshold=8.0, progress=False,
+                                 output_dir=str(tmp_path))
+        assert res["fingerprint"] == base["fingerprint"]
+
+    def test_fleet_lease_carries_jerk_keys(self, jerk_pulsar_file,
+                                           jerk_run, tmp_path):
+        from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+
+        spec = {"fname": jerk_pulsar_file, "dmmin": 130.0,
+                "dmmax": 170.0, "workload": "periodicity",
+                "accel_max": E2E_ACCEL_MAX, "n_accel": 5,
+                "jerk_max": E2E_JERK_MAX, "n_jerk": 5,
+                "snr_threshold": 8.0,
+                "chunk_length": 4096 * E2E_TSAMP}
+        with FleetCoordinator(str(tmp_path), auto_sweep=False) as coord:
+            units = coord.add_job(spec)
+            assert len(units) == 1
+            rec = coord._files[os.path.abspath(jerk_pulsar_file)]
+            # the coordinator plans the jerk job under the driver's
+            # fingerprint: unit completions read the ledger the
+            # worker's periodicity_search actually writes
+            assert rec["fingerprint"] == jerk_run["fingerprint"]
+            reg = coord.register({"healthz_url": None})
+            leases = coord.lease({"worker": reg["worker"]})["leases"]
+            cfg = leases[0]["config"]
+            assert cfg["jerk_max"] == E2E_JERK_MAX
+            assert cfg["n_jerk"] == 5
+            # jerk knobs on a single-pulse config: rejected at intake
+            with pytest.raises(ValueError, match="periodicity"):
+                coord.add_survey([jerk_pulsar_file], dmmin=1.0,
+                                 dmmax=2.0, jerk_max=10.0)
+            with pytest.raises(ValueError, match="accel_backend"):
+                coord.add_survey([jerk_pulsar_file], dmmin=1.0,
+                                 dmmax=2.0, workload="periodicity",
+                                 accel_backend="warp")
+
+    def test_validate_spec_jerk_rules(self, jerk_pulsar_file):
+        from pulsarutils_tpu.beams.service import validate_spec
+
+        ok = validate_spec({"fname": jerk_pulsar_file, "dmmin": 1,
+                            "dmmax": 2, "workload": "periodicity",
+                            "accel_max": 10.0, "jerk_max": 5.0,
+                            "n_jerk": 5, "accel_backend": "fdas"})
+        assert ok["jerk_max"] == 5.0 and ok["accel_backend"] == "fdas"
+        with pytest.raises(ValueError, match="periodicity"):
+            validate_spec({"fname": jerk_pulsar_file, "dmmin": 1,
+                           "dmmax": 2, "jerk_max": 5.0})
+        with pytest.raises(ValueError, match="periodicity"):
+            validate_spec({"fname": jerk_pulsar_file, "dmmin": 1,
+                           "dmmax": 2, "accel_backend": "fdas"})
+        with pytest.raises(ValueError, match="jerk_max"):
+            validate_spec({"fname": jerk_pulsar_file, "dmmin": 1,
+                           "dmmax": 2, "workload": "periodicity",
+                           "jerk_max": -1.0})
+        with pytest.raises(ValueError, match="accel_backend"):
+            validate_spec({"fname": jerk_pulsar_file, "dmmin": 1,
+                           "dmmax": 2, "workload": "periodicity",
+                           "accel_backend": "warp"})
+
+    def test_driver_rejects_unknown_backend(self, jerk_pulsar_file,
+                                            tmp_path):
+        with pytest.raises(ValueError, match="accel_backend"):
+            periodicity_search(jerk_pulsar_file, 130.0, 170.0,
+                               accel_backend="warp",
+                               output_dir=str(tmp_path))
+
+    def test_cli_exposes_jerk_and_backend_flags(self):
+        from pulsarutils_tpu.cli.period_main import build_parser
+
+        opts = build_parser().parse_args(
+            ["f.fil", "--jerk-max", "4.4e5", "--n-jerk", "5",
+             "--accel-backend", "fdas"])
+        assert opts.jerk_max == 4.4e5 and opts.n_jerk == 5
+        assert opts.accel_backend == "fdas"
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["f.fil", "--accel-backend",
+                                       "warp"])
+
+
+# ---------------------------------------------------------------------------
+# report surfacing
+# ---------------------------------------------------------------------------
+
+def test_report_carries_jerk_and_backend():
+    from pulsarutils_tpu.obs.report import build_report, render_markdown
+
+    summary = {"n_dm": 4, "n_accel": 3, "n_jerk": 5,
+               "accel_backend": "fdas", "nout": 128, "rebin": 2,
+               "t_obs_s": 12.8, "raw_candidates": 1, "kept": 1,
+               "rejected": {}, "canary": None,
+               "candidates": [{"freq": 60.0, "dm": 150.0, "accel": 9e5,
+                               "jerk": 2.2e5, "sigma": 30.0, "nharm": 4,
+                               "h": 99.0}]}
+    md = render_markdown(build_report(meta={"root": "x"},
+                                      periodicity=summary))
+    assert ("4 DM x 3 acceleration trials x 5 jerk trials "
+            "(fdas backend)") in md
+    assert "jerk (m/s^3)" in md
+    # a jerk-less summary keeps the exact pre-jerk table and line
+    old = dict(summary)
+    del old["n_jerk"], old["accel_backend"]
+    md_old = render_markdown(build_report(meta={"root": "x"},
+                                          periodicity=old))
+    assert "4 DM x 3 acceleration trials over a" in md_old
+    assert "jerk (m/s^3)" not in md_old
