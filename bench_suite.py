@@ -1694,16 +1694,136 @@ def config20(quick):
           "fdas_wall_s": round(fdas_wall, 3)})
 
 
+def config21(quick):
+    """Precision-policy A/B (ISSUE 17): ``bf16_operand_f32_accum`` —
+    bfloat16 operands feeding a float32 accumulator, the
+    bandwidth-bound-sweep strategy — against the plain-f32 default on
+    the SAME jit gather sweep, at a geometry past the float32
+    exact-integer domain (quick: > 2^24 summed plane elements; full:
+    the SERIES itself beyond 2^24 samples, where
+    ``precision.exactness_domain`` reports peak-index exactness lost —
+    the regime the policy engine exists for).
+
+    ``value`` is the f32/bf16 steady-state wall ratio (> 1.0 means the
+    half-width operands pay for themselves) — FORCED to 0.0, far past
+    any tolerance, when either
+
+    * the two arms' best candidates diverge in any discrete field
+      (DM row, rebin window, peak sample) or miss the injected trial, or
+    * the bf16 arm's dedispersed profile at the injected trial violates
+      the strategy's documented error bound
+      (``Strategy.error_bound(nchan)`` relative to the per-sample
+      absolute operand sum) against a float64 oracle.
+
+    Same contract the autotuner's exact-hit-match harness enforces
+    before ever caching a (kernel, policy) winner — here re-checked
+    end-to-end through ``dedispersion_search`` with an explicit policy.
+    """
+    from pulsarutils_tpu.ops.search import (_offsets_for,
+                                            dedispersion_search)
+    from pulsarutils_tpu.precision import STRATEGIES, exactness_domain
+    from pulsarutils_tpu.tuning.autotune import synthetic_chunk
+
+    if quick:
+        nchan, nsamples, ndm = 16, (1 << 20) + 4096, 8
+    else:
+        nchan, nsamples, ndm = 8, (1 << 24) + (1 << 16), 4
+    geom = (1400.0, 400.0, 5e-4)  # start_freq, bandwidth, sample_time
+    dms = np.linspace(40.0, 80.0, ndm)
+    offsets = _offsets_for(dms, nchan, *geom, nsamples)
+    inj = ndm // 2
+    data = synthetic_chunk(nchan, nsamples, offsets[inj], seed=21)
+    dom = exactness_domain(nchan, nsamples)
+    kw = dict(backend="jax", trial_dms=dms, kernel="gather")
+
+    def run(policy, capture=False):
+        return dedispersion_search(data, None, None, *geom,
+                                   capture_plane=capture,
+                                   precision=policy, **kw)
+
+    # warm-up arm per policy absorbs the compiles; the bf16 arm's plane
+    # is captured ONCE here for the oracle bound check (the timed calls
+    # never capture — plane readback is not part of the A/B)
+    t_f32 = run("f32")
+    t_bf16, plane_bf16 = run("bf16_operand_f32_accum", capture=True)
+
+    reps = 3 if quick else 5
+
+    def steady_wall(policy):
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(policy)
+            walls.append(time.perf_counter() - t0)
+        walls.sort()
+        return walls[len(walls) // 2]
+
+    f32_wall = steady_wall("f32")
+    bf16_wall = steady_wall("bf16_operand_f32_accum")
+
+    def best(tbl):
+        i = int(np.argmax(np.asarray(tbl["snr"])))
+        return (i, int(np.asarray(tbl["rebin"])[i]),
+                int(np.asarray(tbl["peak"])[i]))
+
+    b32, b16 = best(t_f32), best(t_bf16)
+    cell_ok = b32 == b16 and b32[0] == inj
+    if not cell_ok:
+        log(f"config 21: best candidates diverged or missed the "
+            f"injected trial {inj}: f32={b32} bf16={b16}")
+
+    # float64 oracle for the injected trial's dedispersed profile,
+    # channel-at-a-time (the full-preset plane is ~0.5 GB in f64 —
+    # never materialise more than one channel row):
+    # out[t] = sum_c data[c, (t + off[c]) mod T]  ==  sum_c roll(row, -off)
+    prof64 = np.zeros(nsamples, dtype=np.float64)
+    abs64 = np.zeros(nsamples, dtype=np.float64)
+    for c in range(nchan):
+        rolled = np.roll(data[c].astype(np.float64),
+                         -int(offsets[inj, c]))
+        prof64 += rolled
+        abs64 += np.abs(rolled)
+    bound = STRATEGIES["bf16_operand_f32_accum"].error_bound(nchan)
+    got = np.asarray(plane_bf16[inj], dtype=np.float64)
+    excess = np.abs(got - prof64) - (bound * abs64 + 1e-6)
+    bound_ok = bool((excess <= 0.0).all())
+    if not bound_ok:
+        log(f"config 21: bf16 plane violates the documented error bound "
+            f"({bound:.3e} rel) by up to {float(excess.max()):.3e}")
+
+    ok = cell_ok and bound_ok
+    emit({"config": 21, "metric": "precision-policy A/B: bf16 operands "
+          f"+ f32 accumulation vs plain f32, {nchan}x{nsamples} gather "
+          f"sweep over {ndm} trials (> 2^24 summed elements"
+          + ("" if dom.peak_index_exact
+             else ", peak-index exactness lost") + ")",
+          "value": round(f32_wall / bf16_wall, 4) if ok else 0.0,
+          "unit": "x (f32/bf16 wall; 0 = discrete divergence or "
+                  "error-bound violation)",
+          "best_match": bool(cell_ok),
+          "bound_ok": bool(bound_ok),
+          "error_bound_rel": bound,
+          "max_bound_excess": float(excess.max()),
+          "peak_index_exact": bool(dom.peak_index_exact),
+          "f32_wall_s": round(f32_wall, 3),
+          "bf16_wall_s": round(bf16_wall, 3)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13, 14, 15, 16, 17, 18, 19, 20])
+                                 13, 14, 15, 16, 17, 18, 19, 20, 21])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
                              "lines) — the snapshot tools/perf_gate.py "
                              "compares against a committed baseline")
+    parser.add_argument("--backend", default=None, metavar="NAME",
+                        help="backend lane stamped into the snapshot "
+                             "header (default: jax.default_backend()); "
+                             "tools/perf_gate.py refuses to compare "
+                             "snapshots across backend lanes")
     opts = parser.parse_args(argv)
     quick = os.environ.get("BENCH_PRESET") == "quick"
     # hermetic kernel-autotune cache unless the caller set one
@@ -1728,7 +1848,7 @@ def main(argv=None):
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
            15: config15, 16: config16, 17: config17, 18: config18,
-           19: config19, 20: config20}
+           19: config19, 20: config20, 21: config21}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:
@@ -1739,11 +1859,28 @@ def main(argv=None):
     if opts.metrics_out:
         from pulsarutils_tpu.obs.gate import SCHEMA_VERSION
         from pulsarutils_tpu.obs.metrics import REGISTRY
+        from pulsarutils_tpu.precision import policy_name
 
+        backend = opts.backend
+        if backend is None:
+            try:
+                import jax
+
+                backend = jax.default_backend()
+            except Exception:
+                backend = "cpu"
         with open(opts.metrics_out, "w") as f:
             # versioned header first: the gate REFUSES snapshots whose
-            # schema drifted instead of silently comparing them
-            f.write(json.dumps({"schema_version": SCHEMA_VERSION}) + "\n")
+            # schema drifted instead of silently comparing them — and
+            # (v3) stamps the bench LANE: walls only compare within one
+            # (JAX backend, precision policy) pair, so the gate can
+            # refuse a cross-backend or cross-policy comparison
+            f.write(json.dumps({
+                "schema_version": SCHEMA_VERSION,
+                "backend": backend,
+                "precision_policy": policy_name(
+                    os.environ.get("PUTPU_PRECISION")),
+            }) + "\n")
             for rec in RECORDS:
                 f.write(json.dumps(rec) + "\n")
             # registry tail: counters/gauges/histograms the configs'

@@ -42,7 +42,7 @@ class BeamGeometryError(ValueError):
     """Beams offered for one batch do not share a chunk geometry."""
 
 
-def _beam_body(chan_block, formulation, packed, prep):
+def _beam_body(chan_block, formulation, packed, prep, policy=None):
     """The per-beam traceable body shared by the batched and
     single-beam kernels — ONE definition, so the two programs can never
     drift and the bit-identity contract is structural.
@@ -75,13 +75,15 @@ def _beam_body(chan_block, formulation, packed, prep):
         return search_kernel_fn(beam, offset_blocks,
                                 capture_plane=False,
                                 chan_block=chan_block,
-                                formulation=formulation)
+                                formulation=formulation,
+                                policy=policy)
 
     return body
 
 
 @functools.lru_cache(maxsize=PLAN_CACHE_SIZE)
-def batched_search_kernel(chan_block, formulation, packed=None, prep=None):
+def batched_search_kernel(chan_block, formulation, packed=None, prep=None,
+                          policy=None):
     """ONE jitted program: ``lax.map`` over the beam axis of the
     single-beam search kernel.
 
@@ -101,7 +103,7 @@ def batched_search_kernel(chan_block, formulation, packed=None, prep=None):
     """
     import jax
 
-    body = _beam_body(chan_block, formulation, packed, prep)
+    body = _beam_body(chan_block, formulation, packed, prep, policy)
 
     @jax.jit
     def kernel(data, offset_blocks):
@@ -111,14 +113,15 @@ def batched_search_kernel(chan_block, formulation, packed=None, prep=None):
 
 
 @functools.lru_cache(maxsize=PLAN_CACHE_SIZE)
-def single_beam_kernel(chan_block, formulation, packed=None, prep=None):
+def single_beam_kernel(chan_block, formulation, packed=None, prep=None,
+                       policy=None):
     """The sequential arm for packed/prep batchers: the SAME per-beam
     body as :func:`batched_search_kernel`, without the batch map — the
     bit-identity reference (and the host-unpack A/B partner when fed
     float codes with ``packed=None``)."""
     import jax
 
-    body = _beam_body(chan_block, formulation, packed, prep)
+    body = _beam_body(chan_block, formulation, packed, prep, policy)
 
     @jax.jit
     def kernel(beam, offset_blocks):
@@ -208,7 +211,8 @@ class BeamBatcher:
 
     def __init__(self, nchan, nsamples, trial_dms, start_freq, bandwidth,
                  sample_time, *, dm_block=None, chan_block=None,
-                 kernel=None, batch_hint=1, packed=None, prep=None):
+                 kernel=None, batch_hint=1, packed=None, prep=None,
+                 precision=None):
         self.nchan = int(nchan)
         self.nsamples = int(nsamples)
         self.trial_dms = np.asarray(trial_dms, dtype=np.float64)
@@ -242,6 +246,19 @@ class BeamBatcher:
                 "formulations ('roll'/'gather') can ride inside the "
                 "batch map")
         self.kernel = kernel
+        # precision policy is fixed at construction (it keys the jitted
+        # programs and the bit-identity contract only holds within one
+        # policy); "auto" degrades to f32 — the policy tuner measures
+        # the single-beam dispatch surface, and every beam of a batch
+        # must run ONE policy for the stacked packs to stay comparable
+        from ..precision import engage, resolve_policy
+
+        eff_policy = resolve_policy(precision)
+        if eff_policy == "auto":
+            eff_policy = "f32"
+        self.policy = None if eff_policy == "f32" else eff_policy
+        if self.policy is not None:
+            engage(self.policy)
         self.prep = ((bool(prep[0]), int(prep[1]))
                      if prep is not None else None)
         self.packed_meta = None
@@ -391,7 +408,8 @@ class BeamBatcher:
             return (self.search(blocks[:cap])
                     + self.search(blocks[cap:]))
         kernel = batched_search_kernel(self.chan_block, self.kernel,
-                                       self.packed_meta, self.prep)
+                                       self.packed_meta, self.prep,
+                                       self.policy)
         try:
             fault_inject.fire("beams", chunk=None, batch=len(blocks))
             with budget_bucket("search/dispatch"):
@@ -434,14 +452,16 @@ class BeamBatcher:
         searched = self._searched_len(raw_len)
         if self.packed_meta is not None or self.prep is not None:
             kernel = single_beam_kernel(self.chan_block, self.kernel,
-                                        self.packed_meta, self.prep)
+                                        self.packed_meta, self.prep,
+                                        self.policy)
 
             def operand():
                 return self._stack([block])[0]
         else:
             from ..ops.search import _jax_search_kernel
 
-            kernel = _jax_search_kernel(False, self.chan_block, self.kernel)
+            kernel = _jax_search_kernel(False, self.chan_block, self.kernel,
+                                        policy=self.policy)
 
             def operand():
                 return jnp.asarray(block, dtype=jnp.float32)

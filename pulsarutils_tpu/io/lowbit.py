@@ -153,13 +153,14 @@ def accum_dtype(nbits, nchan):
       with an exact-representable running sum never rounds;
     * at or above 2^24 the exactness argument breaks and callers must
       stay on the float32 path (``None`` is returned).
+
+    The ladder itself lives in :func:`..precision.exactness_domain`,
+    the single owner of the 2^24 bound (ISSUE 17) — this wrapper keeps
+    the historic call signature.
     """
-    peak = ((1 << int(nbits)) - 1) * int(nchan)
-    if peak < (1 << 15):
-        return "int16"
-    if peak < (1 << 24):
-        return "int32"
-    return None
+    from ..precision import exactness_domain
+
+    return exactness_domain(nchan, nbits=nbits).accum_dtype
 
 
 def device_unpack_block(frames, nbits, nchan, band_descending=False,

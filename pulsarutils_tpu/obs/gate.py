@@ -24,7 +24,8 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["DEFAULT_REL_TOL", "SCHEMA_VERSION", "load_snapshot",
+__all__ = ["DEFAULT_REL_TOL", "LANE_KEYS", "SCHEMA_VERSION",
+           "load_header", "load_snapshot", "header_mismatch",
            "lower_is_better", "compare", "format_report",
            "check_lint_report", "unknown_budget_counters"]
 
@@ -36,7 +37,18 @@ __all__ = ["DEFAULT_REL_TOL", "SCHEMA_VERSION", "load_snapshot",
 #: schema drift must fail loudly, not pass as a 100%-ratio no-op.
 #: v2 (ISSUE 14): BUDGET_JSON grew the ``chunk_wall_s`` p50/p95/p99
 #: block, and the suite grew config 18 — regenerate baselines.
-SCHEMA_VERSION = 2
+#: v3 (ISSUE 17): the snapshot header grew the ``backend`` and
+#: ``precision_policy`` lane stamps (walls are only comparable within
+#: one (JAX backend, precision policy) lane) and the suite grew
+#: config 21 — regenerate baselines.
+SCHEMA_VERSION = 3
+
+#: header keys that define a snapshot's **bench lane**.  Walls measured
+#: on different JAX backends, or under different accumulation-precision
+#: policies (``PUTPU_PRECISION``), are measurements of different
+#: machines/different math — the gate refuses to compare across lanes
+#: instead of laundering a backend swap through a generous tolerance.
+LANE_KEYS = ("backend", "precision_policy")
 
 #: default relative tolerance — CPU wall-clock on shared runners jitters
 #: by tens of percent; the gate targets step regressions (2x+), so a
@@ -52,6 +64,52 @@ def lower_is_better(unit):
     """Direction from the record's unit string."""
     unit = (unit or "").strip().lower()
     return unit.startswith(_LATENCY_PREFIXES)
+
+
+def load_header(path):
+    """The snapshot's leading ``schema_version`` header line, as a dict.
+
+    Returns ``{}`` when the first non-empty line is not a header (the
+    pre-ISSUE-5 artifact shape) — lane fields then read as absent, which
+    :func:`header_mismatch` treats as "undeclared", not as a clash.
+    """
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                return {}
+            if (isinstance(rec, dict) and "schema_version" in rec
+                    and "config" not in rec):
+                return rec
+            return {}
+    return {}
+
+
+def header_mismatch(baseline_header, fresh_header):
+    """``None`` when the two snapshots share a bench lane, else a
+    human-readable refusal.
+
+    A lane key (:data:`LANE_KEYS`) clashes only when **both** headers
+    declare it and the values differ — a pre-lane snapshot that never
+    stamped ``backend``/``precision_policy`` still gates (ad-hoc
+    tooling over old artifacts), but two stamped snapshots from
+    different backends or precision policies must never have their
+    walls compared as if they measured the same thing.
+    """
+    for key in LANE_KEYS:
+        base = baseline_header.get(key)
+        fresh = fresh_header.get(key)
+        if base is not None and fresh is not None and base != fresh:
+            return (f"{key} mismatch: baseline is {base!r}, fresh "
+                    f"snapshot is {fresh!r} — each (backend, precision "
+                    "policy) lane gates against its own "
+                    "BENCH_GATE_<backend>.jsonl baseline; regenerate "
+                    "one for this lane instead of comparing across")
+    return None
 
 
 def load_snapshot(path, expect_version=None):

@@ -238,6 +238,15 @@ METRIC_NAMES = {
         "geometry-keyed plan/program cache hits (labelled by cache)",
     "putpu_plan_cache_misses_total":
         "geometry-keyed plan/program cache misses (labelled by cache)",
+    "putpu_precision_compensated_engagements_total":
+        "dispatches that engaged a compensated/split accumulation "
+        "strategy (labelled by policy)",
+    "putpu_precision_overflow_averted_total":
+        "exactness-domain checks that pushed an integer sweep back to "
+        "float32 (code peak at or above 2^24)",
+    "putpu_precision_policy_resolutions_total":
+        "precision-policy resolutions at dispatch surfaces (labelled "
+        "by policy)",
     "putpu_persist_retries_total":
         "candidate persists re-attempted after OSError",
     "putpu_quarantine_records_total":

@@ -121,7 +121,21 @@ def _jax():
     return jax, jnp
 
 
-def dedisperse_block_roll_jax(data, offsets):
+def _policy_strategy(policy):
+    """Resolve a non-default policy name to its Strategy (None for f32).
+
+    Lazy so the default ``policy=None`` trace never imports (or pays
+    for) the precision engine — byte-identity with the pre-policy
+    programs is pinned by test.
+    """
+    if policy in (None, "f32"):
+        return None
+    from ..precision import STRATEGIES, policy_name
+
+    return STRATEGIES[policy_name(policy)]
+
+
+def dedisperse_block_roll_jax(data, offsets, policy=None):
     """Roll-accumulate formulation of :func:`dedisperse_block_jax`.
 
     Scans over channels; each step adds every trial's circular roll of
@@ -146,9 +160,18 @@ def dedisperse_block_roll_jax(data, offsets):
     normal f32 reassociation tolerance, and the exactness-sensitive
     consumers compare per-backend (the hybrid's rescore and the direct
     kernel route through the SAME formulation on a given backend).
+
+    ``policy`` selects a :mod:`..precision` accumulation strategy for
+    float inputs: compensated/split strategies thread a two-float
+    (sum, compensation) carry through the channel scan;
+    ``bf16_operand_f32_accum`` rolls bfloat16 rows and accumulates in
+    float32.  ``None``/``"f32"`` is the unchanged default path.
     """
     jax, jnp = _jax()
     t = data.shape[1]
+    strat = _policy_strategy(policy)
+    if strat is not None and jnp.issubdtype(data.dtype, jnp.integer):
+        strat = None  # integer ladder is already exact; policy is a no-op
     # dynamic_slice CLAMPS out-of-range starts where the gather's index
     # arithmetic wraps mod T — re-wrap here so a caller passing raw
     # (un-normalised) shifts gets the same circular semantics on every
@@ -165,6 +188,39 @@ def dedisperse_block_roll_jax(data, offsets):
     # over the mesh axes, and lax.scan rejects the carry-type mismatch
     # (same constraint as the chunked fori_loop below, found live on a
     # chan-sharded mesh in round 5).  Bit-identical: 0 + c0 == c0 in f32.
+    if strat is not None and strat.operand_dtype == "bfloat16":
+        from ..precision import cast_operand
+
+        data = cast_operand(data, strat.name, jnp)
+        acc0 = roll_rows(data[0], offsets[:, 0]).astype(jnp.float32)
+
+        def body_bf16(acc, co):
+            row, offs_c = co
+            return acc + roll_rows(row, offs_c).astype(jnp.float32), None
+
+        acc, _ = jax.lax.scan(body_bf16, acc0,
+                              (data[1:], offsets[:, 1:].T))
+        return acc
+
+    if strat is not None and strat.accumulator in ("compensated", "split"):
+        # Two-float carry (Knuth TwoSum per step): the compensation is
+        # seeded varying (acc0 - acc0, numerically zero) for the same
+        # shard_map carry-type reason as acc0 itself.
+        acc0 = roll_rows(data[0], offsets[:, 0])
+
+        def body_comp(carry, co):
+            acc, comp = carry
+            row, offs_c = co
+            v = roll_rows(row, offs_c)
+            s = acc + v
+            bp = s - acc
+            comp = comp + ((acc - (s - bp)) + (v - bp))
+            return (s, comp), None
+
+        (acc, comp), _ = jax.lax.scan(body_comp, (acc0, acc0 - acc0),
+                                      (data[1:], offsets[:, 1:].T))
+        return acc + comp
+
     acc0 = roll_rows(data[0], offsets[:, 0])
 
     def body(acc, co):
@@ -175,7 +231,7 @@ def dedisperse_block_roll_jax(data, offsets):
     return acc
 
 
-def dedisperse_block_jax(data, offsets, formulation=None):
+def dedisperse_block_jax(data, offsets, formulation=None, policy=None):
     """Dedisperse a block of trials on device.
 
     Parameters
@@ -188,6 +244,11 @@ def dedisperse_block_jax(data, offsets, formulation=None):
     formulation : ``None`` (backend-resolved, below), ``"gather"`` or
         ``"roll"`` — forced, so the autotuner can measure both families
         on any backend instead of trusting the static rule.
+    policy : ``None`` or a :mod:`..precision` strategy name — selects
+        the float accumulation strategy (compensated / two-float
+        pairwise / bf16-operand).  ``None``/``"f32"`` keeps the
+        pre-policy program byte-identical; integer inputs ignore the
+        policy (the exact-integer ladder already owns them).
 
     Returns
     -------
@@ -205,8 +266,17 @@ def dedisperse_block_jax(data, offsets, formulation=None):
         formulation = ("roll" if jax.default_backend() == "cpu"
                        else "gather")
     if formulation == "roll":
-        return dedisperse_block_roll_jax(data, offsets)
+        return dedisperse_block_roll_jax(data, offsets, policy=policy)
     t = data.shape[1]
+    strat = _policy_strategy(policy)
+    if strat is not None and jnp.issubdtype(data.dtype, jnp.integer):
+        strat = None  # integer ladder is already exact; policy is a no-op
+    if strat is not None and strat.operand_dtype == "bfloat16":
+        # narrow BEFORE the gather so the memory-bound gather itself
+        # moves half the bytes — the whole point of the strategy
+        from ..precision import cast_operand
+
+        data = cast_operand(data, strat.name, jnp)
     tidx = jnp.arange(t, dtype=jnp.int32)
     # idx[d, c, t] = (t + off[d, c]) mod T
     idx = (tidx[None, None, :] + offsets[:, :, None]) % t
@@ -221,11 +291,19 @@ def dedisperse_block_jax(data, offsets, formulation=None):
         # accum_dtype states the bound).  The explicit dtype pins the
         # reduction against numpy-style silent promotion to int64.
         return gathered.sum(axis=1, dtype=data.dtype)
-    return gathered.sum(axis=1)
+    if strat is None:
+        return gathered.sum(axis=1)
+    if strat.operand_dtype == "bfloat16":
+        return gathered.astype(jnp.float32).sum(axis=1)
+    from ..precision import neumaier_sum, split_sum
+
+    if strat.accumulator == "compensated":
+        return neumaier_sum(gathered, axis=1, xp=jnp)
+    return split_sum(gathered, axis=1, xp=jnp)
 
 
 def dedisperse_block_chunked_jax(data, offsets, chan_block=None,
-                                 formulation=None):
+                                 formulation=None, policy=None):
     """Like :func:`dedisperse_block_jax` but accumulates over channel blocks.
 
     Bounds the gather workspace to ``ndm_block * chan_block * T`` elements so
@@ -235,13 +313,19 @@ def dedisperse_block_chunked_jax(data, offsets, chan_block=None,
     (forced, or the CPU default) the workspace is already
     ``O(ndm_block * T)``, so chunking would only add loop overhead and
     is skipped.
+
+    A non-default ``policy`` applies *within* each channel block; the
+    cross-block accumulation stays plain float32 (nblocks is small, so
+    the extra term is ``nblocks * eps`` — negligible next to the
+    in-block bound each strategy documents).
     """
     jax, jnp = _jax()
     nchan = data.shape[0]
     eff = formulation or ("roll" if jax.default_backend() == "cpu"
                           else "gather")
     if chan_block is None or chan_block >= nchan or eff == "roll":
-        return dedisperse_block_jax(data, offsets, formulation=eff)
+        return dedisperse_block_jax(data, offsets, formulation=eff,
+                                    policy=policy)
     assert nchan % chan_block == 0, (nchan, chan_block)
     nblocks = nchan // chan_block
     t = data.shape[1]
@@ -254,7 +338,7 @@ def dedisperse_block_chunked_jax(data, offsets, chan_block=None,
 
     def body(i, acc):
         return acc + dedisperse_block_jax(data_b[i], off_b[i],
-                                          formulation=eff)
+                                          formulation=eff, policy=policy)
 
     # the carry is seeded with block 0 (not zeros): under shard_map a
     # zeros-constant carry is UNVARYING while the body's sum is varying
@@ -262,5 +346,6 @@ def dedisperse_block_chunked_jax(data, offsets, chan_block=None,
     # mismatch (hit live on a (n, 1) mesh whose per-device gather
     # exceeded the chan_block budget — round 5).  Bit-identical:
     # 0 + b0 == b0 in f32.
-    acc0 = dedisperse_block_jax(data_b[0], off_b[0], formulation=eff)
+    acc0 = dedisperse_block_jax(data_b[0], off_b[0], formulation=eff,
+                                policy=policy)
     return jax.lax.fori_loop(1, nblocks, body, acc0)

@@ -89,16 +89,47 @@ def _add_harmonic(acc, power, j, xp):
     return acc + xp.where(valid, gathered, 0.0)
 
 
-def harmonic_sum(power, nharm, xp=np):
+def _add_harmonic_comp(acc, comp, power, j, xp):
+    """Compensated (TwoSum) variant of :func:`_add_harmonic`.
+
+    Carries the rounding error of each harmonic add in ``comp`` — the
+    ``f32_compensated``/``split_f32`` policy's path through the stack
+    (the harmonic count is small, so the two strategies share the
+    sequential compensated form here).
+    """
+    n = power.shape[-1]
+    idx = xp.arange(n) * j
+    valid = idx < n
+    gathered = xp.take(power, xp.where(valid, idx, 0), axis=-1)
+    v = xp.where(valid, gathered, 0.0)
+    s = acc + v
+    bp = s - acc
+    comp = comp + ((acc - (s - bp)) + (v - bp))
+    return s, comp
+
+
+def harmonic_sum(power, nharm, xp=np, policy=None):
     """Stretch-sum the first ``nharm`` harmonics of every fundamental bin.
 
     ``out[..., i] = sum_{j=1..nharm} power[..., i * j]`` with out-of-range
     harmonics contributing zero.  A bin whose fundamental is ``i`` collects
     the power a narrow pulse spreads over its harmonics; under the null the
     result is Erlang(``nharm``) when ``power`` is Exp(1)-normalised.
+
+    ``policy`` selects a :mod:`..precision` accumulation strategy for
+    the harmonic adds (``None``/``"f32"`` = the unchanged plain path).
     """
     power = xp.asarray(power)
     out = xp.zeros_like(power)
+    if policy not in (None, "f32"):
+        from ..precision import STRATEGIES, policy_name
+
+        strat = STRATEGIES[policy_name(policy)]
+        if strat.accumulator in ("compensated", "split"):
+            comp = xp.zeros_like(power)
+            for j in range(1, int(nharm) + 1):
+                out, comp = _add_harmonic_comp(out, comp, power, j, xp)
+            return out + comp
     for j in range(1, int(nharm) + 1):
         out = _add_harmonic(out, power, j, xp)
     return out
@@ -144,7 +175,7 @@ def sf_log_to_sigma(log_sf, xp=np):
 # ---------------------------------------------------------------------------
 
 def score_normalized_power(power, nsamples, tsamp, max_harmonics=16,
-                           fmin=None, fmax=None, xp=np):
+                           fmin=None, fmax=None, xp=np, policy=None):
     """Harmonic-sum scoring of an already Exp(1)-normalised power
     spectrum ``power`` (..., nbins) of a length-``nsamples`` series.
 
@@ -154,7 +185,21 @@ def score_normalized_power(power, nsamples, tsamp, max_harmonics=16,
     trial spectra through the IDENTICAL harmonic-sum / false-alarm /
     sigma chain — the cell-for-cell agreement contract between the
     backends rides on this being one implementation, not two.
+
+    ``policy`` selects the :mod:`..precision` accumulation strategy for
+    the incremental harmonic stack: compensated strategies thread a
+    TwoSum carry through the adds; ``bf16_operand_f32_accum`` gathers
+    bfloat16 bins and accumulates float32 (jax only).
+    ``None``/``"f32"`` is the byte-identical default.
     """
+    strat = None
+    if policy not in (None, "f32"):
+        from ..precision import STRATEGIES, policy_name
+
+        strat = STRATEGIES[policy_name(policy)]
+        if strat.operand_dtype == "bfloat16" and xp is np:
+            raise ValueError("bf16_operand_f32_accum needs the jax path "
+                             "(numpy has no bfloat16)")
     t = int(nsamples)
     nbins = power.shape[-1]
     freqs = xp.arange(nbins) / (t * tsamp)
@@ -174,15 +219,34 @@ def score_normalized_power(power, nsamples, tsamp, max_harmonics=16,
 
     # incremental harmonic accumulation: one gather per harmonic (16 total),
     # scored whenever the depth hits one of HARMONIC_SUMS
+    gath = power
+    if strat is not None and strat.operand_dtype == "bfloat16":
+        # narrow the gathered operand (the bandwidth-bound read); the
+        # accumulator stays float32 below
+        from ..precision import cast_operand
+
+        gath = cast_operand(power, strat.name, xp)
+    compensated = (strat is not None
+                   and strat.accumulator in ("compensated", "split"))
     acc = xp.zeros_like(power)
+    comp = xp.zeros_like(power) if compensated else None
     depth = 0
     for h in HARMONIC_SUMS:
         if h > max_harmonics:
             break
         for j in range(depth + 1, h + 1):
-            acc = _add_harmonic(acc, power, j, xp)
+            if compensated:
+                acc, comp = _add_harmonic_comp(acc, comp, power, j, xp)
+            elif gath is not power:
+                n = power.shape[-1]
+                idx = xp.arange(n) * j
+                valid = idx < n
+                g = xp.take(gath, xp.where(valid, idx, 0), axis=-1)
+                acc = acc + xp.where(valid, g.astype(power.dtype), 0.0)
+            else:
+                acc = _add_harmonic(acc, power, j, xp)
         depth = h
-        hsum = acc * band
+        hsum = (acc + comp if compensated else acc) * band
         peak = xp.argmax(hsum, axis=-1)
         pval = xp.take_along_axis(hsum, peak[..., None], axis=-1)[..., 0]
         log_sf = power_sf_log(pval, nsum=h, xp=xp)
@@ -202,7 +266,7 @@ def score_normalized_power(power, nsamples, tsamp, max_harmonics=16,
 
 
 def spectral_search(series, tsamp, max_harmonics=16, fmin=None, fmax=None,
-                    xp=np):
+                    xp=np, policy=None):
     """FFT periodicity search of ``series`` (..., T).
 
     For every harmonic-sum depth ``h`` in :data:`HARMONIC_SUMS` up to
@@ -212,20 +276,23 @@ def spectral_search(series, tsamp, max_harmonics=16, fmin=None, fmax=None,
     Returns a dict of arrays (leading axes = ``series``'s batch axes):
     ``freq`` (Hz), ``power`` (summed normalised power), ``nharm``,
     ``log_sf`` (single-bin log false-alarm probability) and ``sigma``.
+    ``policy`` threads a :mod:`..precision` accumulation strategy into
+    the harmonic stack (see :func:`score_normalized_power`).
     """
     series = xp.asarray(series)
     t = series.shape[-1]
     power = normalize_power(power_spectrum(series, xp=xp), xp=xp)
     return score_normalized_power(power, t, tsamp,
                                   max_harmonics=max_harmonics,
-                                  fmin=fmin, fmax=fmax, xp=xp)
+                                  fmin=fmin, fmax=fmax, xp=xp,
+                                  policy=policy)
 
 
 _SPEC_KEYS = ("freq", "power", "nharm", "log_sf", "sigma")
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted_spectral_stacked(tsamp, max_harmonics, fmin, fmax):
+def _jitted_spectral_stacked(tsamp, max_harmonics, fmin, fmax, policy=None):
     """One jitted program per (tsamp, depth, band) running the whole
     spectral search and returning the five per-row results as ONE
     ``(5, rows)`` array — eager dispatch costs ~50 op round trips per
@@ -236,24 +303,51 @@ def _jitted_spectral_stacked(tsamp, max_harmonics, fmin, fmax):
     @jax.jit
     def run(chunk):
         spec = spectral_search(chunk, tsamp, max_harmonics=max_harmonics,
-                               fmin=fmin, fmax=fmax, xp=jnp)
+                               fmin=fmin, fmax=fmax, xp=jnp, policy=policy)
         return jnp.stack([spec[k].astype(jnp.float32) if k == "nharm"
                           else spec[k] for k in _SPEC_KEYS])
 
     return run
 
 
-def _spectral_chunk(plane_chunk, tsamp, max_harmonics, fmin, fmax, xp):
-    """Spectral-search one row chunk; host dict out (one readback on jax)."""
+def _spectral_chunk(plane_chunk, tsamp, max_harmonics, fmin, fmax, xp,
+                    kernel="auto", policy=None):
+    """Spectral-search one row chunk; host dict out (one readback on jax).
+
+    ``kernel`` picks the jax scoring program: ``"xla"`` (the jitted
+    :func:`spectral_search` chain), ``"pallas"`` (the one-pass
+    :mod:`.harmonic_pallas` normalize+stack kernel) or ``"auto"`` — the
+    measured selection via
+    :func:`~pulsarutils_tpu.tuning.autotune.resolve_harmonic_kernel`
+    (static fallback ``"xla"``; a Pallas win is only ever cached after
+    the identity harness passes).  The numpy path ignores ``kernel``.
+    """
     if xp is np:
         c = spectral_search(np.asarray(plane_chunk), tsamp,
                             max_harmonics=max_harmonics, fmin=fmin,
-                            fmax=fmax, xp=np)
+                            fmax=fmax, xp=np, policy=policy)
         return {k: np.asarray(v) for k, v in c.items()}
+    rows, t = plane_chunk.shape[-2], plane_chunk.shape[-1]
+    if kernel == "auto":
+        from ..tuning.autotune import resolve_harmonic_kernel
+
+        kernel = resolve_harmonic_kernel(rows, t, float(tsamp),
+                                         max_harmonics=int(max_harmonics),
+                                         fmin=fmin, fmax=fmax,
+                                         policy=policy)
+    if kernel == "pallas":
+        from .harmonic_pallas import spectral_search_pallas
+
+        spec = spectral_search_pallas(plane_chunk, tsamp,
+                                      max_harmonics=max_harmonics,
+                                      fmin=fmin, fmax=fmax, policy=policy)
+        out = {k: np.asarray(v) for k, v in spec.items()}
+        out["nharm"] = np.rint(out["nharm"]).astype(np.int32)
+        return out
     run = _jitted_spectral_stacked(
         float(tsamp), int(max_harmonics),
         None if fmin is None else float(fmin),
-        None if fmax is None else float(fmax))
+        None if fmax is None else float(fmax), policy)
     stacked = np.asarray(run(xp.asarray(plane_chunk)))
     out = dict(zip(_SPEC_KEYS, stacked))
     out["nharm"] = np.rint(out["nharm"]).astype(np.int32)

@@ -114,15 +114,21 @@ def warn_peak_exactness(nsamples, stacklevel=3):
     only below 2^24; every scorer that emits such a pack (the XLA
     :func:`score_profiles_stacked` and the one-pass Pallas
     :func:`..ops.score_pallas.score_plane_pallas`) shares this check so
-    no path silently accepts an over-long series (ADVICE r5).
+    no path silently accepts an over-long series (ADVICE r5).  The
+    bound itself is owned by :func:`..precision.exactness_domain`
+    (ISSUE 17) — this is a consumer, not a second copy of 2^24.
     """
-    if nsamples > (1 << 24):
+    from ..precision import exactness_domain
+
+    dom = exactness_domain(1, nsamples=nsamples)
+    if not dom.peak_index_exact:
         import warnings
 
         warnings.warn(
             f"series length {nsamples} exceeds 2^24: float32 peak "
             "indices lose exactness (off by up to "
-            f"{nsamples / (1 << 24):.1f} samples)", stacklevel=stacklevel)
+            f"{dom.index_error_samples:.1f} samples)",
+            stacklevel=stacklevel)
 
 
 def score_profiles_stacked(plane, xp=np):
@@ -307,7 +313,7 @@ def _search_numpy(data, trial_dms, start_freq, bandwidth, sample_time,
 # ---------------------------------------------------------------------------
 
 def search_kernel_fn(data, offset_blocks, capture_plane=False,
-                     chan_block=None, formulation=None):
+                     chan_block=None, formulation=None, policy=None):
     """The pure, jittable forward step of the search (flagship kernel).
 
     ``data`` is ``(nchan, T)``; ``offset_blocks`` is
@@ -318,14 +324,18 @@ def search_kernel_fn(data, offset_blocks, capture_plane=False,
     blocks are processed by ``lax.map`` so the compiled program is
     independent of the trial count.  ``formulation`` forces the
     dedisperse formulation (``"gather"``/``"roll"``; ``None`` =
-    backend-resolved) — the axis the autotuner measures.
+    backend-resolved) — the axis the autotuner measures.  ``policy``
+    names a :mod:`..precision` accumulation strategy for the channel
+    reduction (``None`` = the byte-identical ``f32`` default) — the
+    second axis the autotuner measures (ISSUE 17).
     """
     import jax
     import jax.numpy as jnp
 
     def per_block(offs):
         plane = dedisperse_block_chunked_jax(data, offs, chan_block,
-                                             formulation=formulation)
+                                             formulation=formulation,
+                                             policy=policy)
         scores = score_profiles_stacked(plane, xp=jnp)
         if capture_plane:
             return scores, plane
@@ -336,7 +346,7 @@ def search_kernel_fn(data, offset_blocks, capture_plane=False,
 
 @functools.lru_cache(maxsize=32)
 def _jax_search_kernel(capture_plane, chan_block, formulation=None,
-                       packed=None):
+                       packed=None, policy=None):
     """The direct-sweep program.  ``packed`` (a
     :meth:`~pulsarutils_tpu.io.lowbit.PackedFrames.meta` tuple) makes
     ``data`` the RAW packed uint8 frames: the bit-unpack runs inside
@@ -355,7 +365,8 @@ def _jax_search_kernel(capture_plane, chan_block, formulation=None,
         return search_kernel_fn(data, offset_blocks,
                                 capture_plane=capture_plane,
                                 chan_block=chan_block,
-                                formulation=formulation)
+                                formulation=formulation,
+                                policy=policy)
 
     return kernel
 
@@ -540,12 +551,18 @@ def _search_jax_fdmt(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
 
 
 def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
-                capture_plane, dm_block, chan_block, dtype, kernel="auto"):
+                capture_plane, dm_block, chan_block, dtype, kernel="auto",
+                precision=None):
     import jax
     import jax.numpy as jnp
 
     from ..io.lowbit import PackedFrames, accum_dtype
+    from ..precision import engage as _engage
+    from ..precision import resolve_policy as _resolve_policy
 
+    # explicit precision wins; else PUTPU_PRECISION; else "f32".  "auto"
+    # defers to the autotuner once the formulation is known (below).
+    eff_policy = _resolve_policy(precision)
     packed = data if isinstance(data, PackedFrames) else None
     nchan, nsamples = np.shape(data)  # PackedFrames reports its logical shape
     ndm = len(trial_dms)
@@ -556,6 +573,10 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
     if kernel == "fourier":
         from .fourier import search_fourier
 
+        if eff_policy not in ("f32", "auto"):
+            raise ValueError("precision policies apply to the gather/roll "
+                             "channel reductions; kernel='fourier' is "
+                             "float32-only")
         if capture_plane == "memmap":
             raise ValueError("capture_plane='memmap' requires "
                              "kernel='pallas'/'auto' or backend='numpy'")
@@ -597,6 +618,10 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
                          "device memory, and the Pallas kernel is "
                          "float32-only")
     if kernel == "pallas":
+        if eff_policy not in ("f32", "auto"):
+            raise ValueError("precision policies apply to the gather/roll "
+                             "channel reductions; kernel='pallas' declares "
+                             "its own f32 accumulation")
         if dtype not in (None, jnp.float32):
             raise ValueError("kernel='pallas' supports float32 only; use "
                              "kernel='gather' for other dtypes")
@@ -636,6 +661,21 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
     formulation = (kernel if kernel in ("gather", "roll")
                    else ("roll" if jax.default_backend() == "cpu"
                          else "gather"))
+    if eff_policy == "auto":
+        # measured (kernel, policy)-pair selection (ISSUE 17): a
+        # non-default strategy only ever wins after the exact-hit-match
+        # harness passes at its stated bound; the static fallback is
+        # the formulation's plain f32 pairing.
+        from ..tuning import autotune as _autotune
+
+        pair = _autotune.resolve_search_policy(
+            formulation, nchan, nsamples, ndm, start_freq, bandwidth,
+            sample_time, trial_dms, dm_block=dm_block,
+            chan_block=chan_block)
+        eff_policy = pair.split("+", 1)[1]
+    policy_arg = None if eff_policy == "f32" else eff_policy
+    if policy_arg is not None:
+        _engage(policy_arg)
     nblocks = len(offset_blocks)
     # preflight (ISSUE 12): a dispatch whose footprint estimate exceeds
     # measured headroom splits BEFORE compiling — no-op when headroom
@@ -650,7 +690,7 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
         try:
             stacked, plane_blocks = _dispatch_direct(
                 data, offset_blocks, capture_plane, chan_block, kernel,
-                packed_meta, passes)
+                packed_meta, passes, policy=policy_arg)
             break
         except (ValueError, TypeError):
             raise  # deterministic configuration error, never OOM
@@ -691,7 +731,7 @@ def _search_jax(data, trial_dms, start_freq, bandwidth, sample_time,
 
 
 def _dispatch_direct(data, offset_blocks, capture_plane, chan_block,
-                     formulation, packed_meta, passes):
+                     formulation, packed_meta, passes, policy=None):
     """One direct-sweep dispatch at the given degradation level.
 
     ``passes == 1`` is the exact pre-resilience path (single dispatch,
@@ -708,7 +748,7 @@ def _dispatch_direct(data, offset_blocks, capture_plane, chan_block,
     import jax.numpy as jnp
 
     kernel_fn = _jax_search_kernel(capture_plane, chan_block, formulation,
-                                   packed_meta)
+                                   packed_meta, policy)
     if passes <= 1:
         roof = roofline.begin()  # wall spans dispatch -> readback
         with budget_bucket("search/dispatch"):
@@ -1542,7 +1582,7 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
                         trial_dms=None, dm_block=None, chan_block=None,
                         dtype=None, kernel="auto", snr_floor=None,
                         noise_certificate=True, rho_cert=None,
-                        cert_slack=None):
+                        cert_slack=None, precision=None):
     """Sweep trial DMs over ``data`` and score each dedispersed series.
 
     Parameters mirror the reference façade
@@ -1620,6 +1660,15 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         (Fourier-domain dedispersion: exact *fractional*-sample delays —
         the precision option for narrow pulses at high time resolution;
         O(ndm * nchan * T) with transcendentals, see :mod:`.fourier`).
+    precision : accumulation-precision policy for the gather/roll
+        channel reductions (:mod:`pulsarutils_tpu.precision`):
+        ``None``/``"f32"`` (the byte-identical default), a strategy
+        name (``"f32_compensated"``, ``"split_f32"``,
+        ``"bf16_operand_f32_accum"``), or ``"auto"`` — the measured
+        (kernel, policy)-pair selection, where a non-default strategy
+        only ever wins after the exact-hit-match equivalence harness
+        passes at its documented error bound.  ``PUTPU_PRECISION``
+        sets the default when the argument is omitted.
 
     Returns
     -------
@@ -1641,6 +1690,12 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
             data = data.to_host()
         elif kernel in ("fdmt", "hybrid"):
             data = data.to_device()
+
+    if precision not in (None, "f32", "auto") and (
+            backend != "jax" or kernel in ("fdmt", "hybrid")):
+        raise ValueError("precision policies apply to the jax gather/roll "
+                         f"channel reductions; got precision={precision!r} "
+                         f"with backend={backend!r}, kernel={kernel!r}")
 
     nchan = data.shape[0]
     if capture_plane is None:
@@ -1731,7 +1786,8 @@ def dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth, sample_time,
         (maxvalues, stds, best_snrs, best_windows, best_peaks,
          plane) = _search_jax(data, trial_dms, start_freq, bandwidth,
                               sample_time, capture_plane, dm_block,
-                              chan_block, dtype, kernel)
+                              chan_block, dtype, kernel,
+                              precision=precision)
     else:
         raise ValueError(f"unknown backend {backend!r}")
 

@@ -42,7 +42,7 @@ from .mesh import pad_to_multiple
 
 @counted_plan_cache("_sharded_kernel", maxsize=PLAN_CACHE_SIZE)
 def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
-                    max_off=0):
+                    max_off=0, policy=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -56,7 +56,7 @@ def _sharded_kernel(mesh, capture_plane, chan_block, kernel="gather",
                                                      max_off)
         else:
             partial = dedisperse_block_chunked_jax(data_local, off_local,
-                                                   chan_block)
+                                                   chan_block, policy=policy)
         dedisp = jax.lax.psum(partial, "chan")
         if kernel == "pallas":
             # undo the host-side offset rebase (see rebase_offsets); the
@@ -94,7 +94,7 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
                                 capture_plane=False, chan_block=None,
                                 dtype=None, kernel="auto",
                                 plane_handle=False, offsets=None,
-                                pallas_max_off=None):
+                                pallas_max_off=None, precision=None):
     """Run the full DM sweep sharded over ``mesh`` axes ``("dm", "chan")``.
 
     Same result contract as
@@ -125,6 +125,13 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
     without it each subset's own bound keys the compiled-program cache,
     and a subset spanning a different offset range silently retraces —
     the retrace detector (``BudgetAccountant``) flags exactly that.
+
+    ``precision`` names a :mod:`~pulsarutils_tpu.precision` accumulation
+    strategy for the per-shard channel partial sums (the cross-shard
+    ``psum`` stays plain f32 — it adds at most ``chan_size`` partials).
+    ``"auto"`` degrades to the static ``f32`` on the mesh path (the
+    policy tuner measures the single-device programs), and the Pallas
+    per-shard kernel only supports plain f32.
     """
     import jax
     import jax.numpy as jnp
@@ -219,8 +226,24 @@ def sharded_dedispersion_search(data, dmmin, dmmax, start_freq, bandwidth,
             max_off = max(max_off, 256)
     else:
         max_off = 0
+
+    from ..precision import engage, resolve_policy
+
+    eff_policy = resolve_policy(precision)
+    if eff_policy == "auto":
+        # the policy tuner measures the single-device programs; on the
+        # mesh path the static f32 default stands
+        eff_policy = "f32"
+    if eff_policy != "f32" and kernel == "pallas":
+        raise ValueError("precision policies other than 'f32' need the "
+                         "gather mesh kernel (the per-shard Pallas "
+                         "kernel accumulates plain f32)")
+    policy_arg = None if eff_policy == "f32" else eff_policy
+    if policy_arg is not None:
+        engage(policy_arg)
+
     compiled = _sharded_kernel(mesh, capture_plane, chan_block, kernel,
-                               max_off)
+                               max_off, policy_arg)
     from ..obs import roofline
 
     roof = roofline.begin()

@@ -1,0 +1,28 @@
+"""Precision-policy engine: named accumulation strategies + exactness rules.
+
+See :mod:`pulsarutils_tpu.precision.policy` — the ONE owner of every
+dtype/accumulation decision the dispatch surfaces used to hard-code.
+"""
+
+from .policy import (  # noqa: F401
+    EPS_BF16,
+    EPS_F32,
+    F32_EXACT_INT_BOUND,
+    STRATEGIES,
+    ExactnessDomain,
+    Strategy,
+    cast_operand,
+    engage,
+    exactness_domain,
+    neumaier_sum,
+    policy_name,
+    resolve_policy,
+    split_sum,
+)
+
+__all__ = [
+    "EPS_BF16", "EPS_F32", "F32_EXACT_INT_BOUND", "STRATEGIES",
+    "ExactnessDomain", "Strategy", "cast_operand", "engage",
+    "exactness_domain", "neumaier_sum", "policy_name", "resolve_policy",
+    "split_sum",
+]

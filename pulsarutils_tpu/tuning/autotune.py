@@ -69,6 +69,7 @@ __all__ = ["KernelTuner", "get_tuner", "set_tuner", "autotune_mode",
            "accel_tables_match", "measure_kernel_wall",
            "resolve_search_kernel", "resolve_mesh_kernel",
            "resolve_batched_kernel", "resolve_accel_backend",
+           "resolve_search_policy", "resolve_harmonic_kernel",
            "decision_seq", "decisions_since", "ACCEL_SIGMA_RTOL",
            "MIN_TUNE_ELEMENTS", "TUNE_REPS", "TUNE_PROBE_TRIALS"]
 
@@ -842,3 +843,189 @@ def resolve_accel_backend(ndm, nsamples, tsamp, accels, jerks=None,
         candidates=candidates, static=static,
         runner_factory=runner_factory, mesh_shape=mesh_shape,
         equiv=accel_tables_match)
+
+
+# ---------------------------------------------------------------------------
+# precision-policy candidates (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def resolve_search_policy(formulation, nchan, nsamples, ndm, start_freq,
+                          bandwidth, sample_time, trial_dms,
+                          dm_block=None, chan_block=None):
+    """``precision="auto"`` resolution: the measured (kernel, policy) pair.
+
+    Candidates are ``"<formulation>+<strategy>"`` pairs over the
+    :mod:`~pulsarutils_tpu.precision` registry — the ledger/BUDGET_JSON
+    record therefore names the winning (kernel, policy) pair directly.
+    The static fallback is the formulation's plain ``f32`` pairing, so
+    ``PUTPU_AUTOTUNE=off`` and below-floor geometries stay on the
+    byte-identical default.  Equivalence is the exact-hit-match harness
+    at each STRATEGY'S OWN stated score tolerance
+    (``Strategy.score_rtol``) — discrete fields (rebin window, peak
+    sample) must match exactly regardless, so a lower-precision variant
+    only ever wins, and is only ever cached, after proving it cannot
+    move a hit.  The ``"-precision"`` backend suffix keeps these
+    decisions in their own key namespace.
+    """
+    import jax
+
+    from ..precision import STRATEGIES
+
+    backend = jax.default_backend()
+    static = f"{formulation}+f32"
+    candidates = [static] + [f"{formulation}+{name}"
+                             for name in STRATEGIES if name != "f32"]
+
+    def runner_factory():
+        from ..ops.search import _offsets_for, _search_jax
+
+        sub_dms = _probe_grid(trial_dms, get_tuner().probe_trials)
+        mid = _offsets_for(sub_dms[len(sub_dms) // 2:len(sub_dms) // 2 + 1],
+                           nchan, start_freq, bandwidth, sample_time,
+                           nsamples)[0]
+        synth = synthetic_chunk(nchan, nsamples, mid)
+
+        def make(pair):
+            pol = pair.split("+", 1)[1]
+
+            def run():
+                scores = _search_jax(synth, sub_dms, start_freq,
+                                     bandwidth, sample_time,
+                                     capture_plane=False,
+                                     dm_block=dm_block,
+                                     chan_block=chan_block, dtype=None,
+                                     kernel=formulation,
+                                     precision=pol)[:5]
+                return (pol, scores)
+
+            return run
+
+        return {c: make(c) for c in candidates}
+
+    def equiv(ref, cand):
+        ref_pol, ref_scores = ref
+        cand_pol, cand_scores = cand
+        del ref_pol
+        return hits_match(ref_scores, cand_scores,
+                          rtol=STRATEGIES[cand_pol].score_rtol)
+
+    return get_tuner().resolve(
+        backend=f"{backend}-precision", nchan=nchan, nsamples=nsamples,
+        ndm=ndm, dtype=dtype_name(None), candidates=candidates,
+        static=static, runner_factory=runner_factory, equiv=equiv)
+
+
+#: cross-program score tolerance for the harmonic-kernel harness: the
+#: Pallas scorer's normalise may round one f32 ulp away from the XLA
+#: chain's (see ops/harmonic_pallas.py), so score columns compare at a
+#: tight rtol while the discrete cell fields compare exactly.
+HARMONIC_SCORE_RTOL = 1e-5
+
+
+def harmonic_packs_match(ref, cand, rtol=HARMONIC_SCORE_RTOL,
+                         bin_scale=None):
+    """The PR 7 rule for the periodicity scoring chain.
+
+    ``ref``/``cand`` are per-row spec dicts (``freq, power, nharm,
+    log_sf, sigma``) over the same probe plane.  Equivalent means: the
+    harmonic depth agrees EXACTLY row-for-row, the peak's frequency
+    names the same BIN (``bin_scale`` = ``nsamples * tsamp`` converts
+    Hz back to the integer bin; the float itself may differ by one ulp
+    between compiled programs — jit turns ``arange/(t*tsamp)`` into a
+    reciprocal multiply, eager divides), and the score columns agree
+    within ``rtol``.
+    """
+    if ref is None or cand is None:
+        return False
+    try:
+        if not np.array_equal(np.asarray(ref["nharm"]),
+                              np.asarray(cand["nharm"])):
+            return False
+        rf = np.asarray(ref["freq"], dtype=np.float64)
+        cf = np.asarray(cand["freq"], dtype=np.float64)
+        if bin_scale is not None:
+            if not np.array_equal(np.rint(rf * float(bin_scale)),
+                                  np.rint(cf * float(bin_scale))):
+                return False
+        elif not np.array_equal(rf, cf):
+            return False
+        for col in ("power", "log_sf", "sigma"):
+            if not np.allclose(np.asarray(cand[col]),
+                               np.asarray(ref[col]), rtol=float(rtol),
+                               atol=1e-6):
+                return False
+        return True
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
+def resolve_harmonic_kernel(nrows, nsamples, tsamp, max_harmonics=16,
+                            fmin=None, fmax=None, policy=None):
+    """``kernel="auto"`` resolution for the periodicity scoring chain.
+
+    Candidates: ``"xla"`` (the jitted :func:`~pulsarutils_tpu.ops.
+    periodicity.spectral_search` chain — the proven default and static
+    fallback) vs ``"pallas"`` (the fused one-pass
+    :mod:`~pulsarutils_tpu.ops.harmonic_pallas` kernel).  Measured over
+    a seeded noise+tone plane at the production geometry, equivalence-
+    gated by :func:`harmonic_packs_match` (discrete fields exact,
+    scores within :data:`HARMONIC_SCORE_RTOL`) and cached per geometry
+    under a ``"-harmonic"`` backend suffix (``nchan`` maps the plane
+    rows, ``ndm`` the harmonic depth).
+    """
+    import jax
+
+    nrows = int(nrows)
+    nsamples = int(nsamples)
+    tsamp = float(tsamp)
+    backend = jax.default_backend()
+    static = "xla"
+    candidates = [static, "pallas"]
+    # the precision policy changes both programs (and the bf16 variant's
+    # tolerance), so it is part of the cache key: a winner measured
+    # under one policy never leaks to another
+    if policy in (None, "f32"):
+        key_dtype = dtype_name(None)
+    else:
+        from ..precision import policy_name
+
+        key_dtype = f"{dtype_name(None)}/{policy_name(policy)}"
+
+    def runner_factory():
+        import jax.numpy as jnp
+
+        from ..ops.harmonic_pallas import spectral_search_pallas
+        from ..ops.periodicity import spectral_search
+
+        rng = np.random.default_rng(1601)
+        probe_rows = min(nrows, 64)
+        plane = rng.standard_normal((probe_rows, nsamples)).astype(
+            np.float32)
+        tt = np.arange(nsamples) * tsamp
+        k0 = max(int(round(0.11 * nsamples)), 4)
+        f0 = k0 / (nsamples * tsamp)
+        plane[probe_rows // 3] += 0.7 * np.sin(2.0 * np.pi * f0 * tt)
+        kw = dict(max_harmonics=max_harmonics, fmin=fmin, fmax=fmax,
+                  policy=policy)
+        kw_xla = dict(kw, xp=jnp)
+        plane_dev = jnp.asarray(plane)
+
+        def run_xla():
+            spec = spectral_search(plane_dev, tsamp, **kw_xla)
+            return {k: np.asarray(v) for k, v in spec.items()}
+
+        def run_pallas():
+            spec = spectral_search_pallas(plane, tsamp, **kw)
+            return {k: np.asarray(v) for k, v in spec.items()}
+
+        return {"xla": run_xla, "pallas": run_pallas}
+
+    def equiv(ref, cand):
+        return harmonic_packs_match(ref, cand,
+                                    bin_scale=nsamples * tsamp)
+
+    return get_tuner().resolve(
+        backend=f"{backend}-harmonic", nchan=nrows,
+        nsamples=nsamples, ndm=int(max_harmonics),
+        dtype=key_dtype, candidates=candidates, static=static,
+        runner_factory=runner_factory, equiv=equiv)

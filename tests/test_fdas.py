@@ -341,16 +341,21 @@ class TestBackendTuning:
         got = autotune.resolve_accel_backend(NDM, NSAMPLES, TSAMP,
                                              ACCELS, **kw)
         assert got in ("time_stretch", "fdas")
-        (dec,) = autotune.decisions_since(mark)
+        # the decision ledger is process-global, so a background thread
+        # from an earlier test can land an unrelated (non-accel) measured
+        # decision in our window while the floor-0 tuner is installed —
+        # assert only over the "-accel|" namespace this test contracts
+        def accel_decisions(since):
+            return [d for d in autotune.decisions_since(since)
+                    if "-accel|" in d["key"]]
+
+        (dec,) = accel_decisions(mark)
         assert dec["kernel"] == got and dec["source"] == "measured"
-        # the "-accel" backend suffix keeps the key from colliding
-        # with a single-pulse kernel entry of the same shape
-        assert "-accel|" in dec["key"]
         # second resolve at the same geometry: memory hit, no decision
         mark = autotune.decision_seq()
         assert autotune.resolve_accel_backend(NDM, NSAMPLES, TSAMP,
                                               ACCELS, **kw) == got
-        assert autotune.decisions_since(mark) == []
+        assert accel_decisions(mark) == []
 
     def test_resolve_equiv_override_gates_candidates(self):
         # the generic harness: a caller-supplied equivalence matcher

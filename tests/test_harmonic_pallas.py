@@ -1,0 +1,213 @@
+"""Identity of the fused Pallas harmonic-stack kernel vs the XLA chain
+(ISSUE 17): host eager, under jit, and on the (4,2)/(2,4) CPU meshes.
+
+The contract (see ``ops/harmonic_pallas.py``): discrete fields — the
+winning harmonic depth and the peak's frequency bin — match the XLA
+``normalize_power -> score_normalized_power`` chain EXACTLY; score
+floats agree at tight ``allclose`` tolerance (XLA may fuse the
+median-normalise divide differently between the two programs, a
+data-dependent last-ulp row scale).  The same contract the autotuner's
+:func:`~pulsarutils_tpu.tuning.autotune.harmonic_packs_match` harness
+gates before caching a Pallas win.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from pulsarutils_tpu.ops.harmonic_pallas import (  # noqa: E402
+    score_power_pallas,
+    spectral_search_pallas,
+)
+from pulsarutils_tpu.ops.periodicity import (  # noqa: E402
+    _spectral_chunk,
+    normalize_power,
+    power_spectrum,
+    score_normalized_power,
+    spectral_search,
+)
+from pulsarutils_tpu.parallel.mesh import (  # noqa: E402
+    make_mesh,
+    shard_map_compat,
+)
+from pulsarutils_tpu.precision import STRATEGIES  # noqa: E402
+
+TSAMP = 1e-3
+KEYS = ("freq", "power", "nharm", "log_sf", "sigma")
+
+POLICIES = [None, "f32_compensated", "bf16_operand_f32_accum"]
+
+
+def _plane(rows=16, t=4096, seed=11):
+    """Noise plane with one strong tone (harmonics populated) and one
+    weak tone — exercises different winning depths across rows."""
+    rng = np.random.default_rng(seed)
+    plane = rng.standard_normal((rows, t)).astype(np.float32)
+    tt = np.arange(t) * TSAMP
+    f0 = 200 / (t * TSAMP)  # exact bin
+    plane[2] += 1.5 * np.square(np.sin(np.pi * f0 * tt))  # pulse train
+    plane[7] += 0.4 * np.sin(2 * np.pi * f0 * tt)
+    return plane
+
+
+def _reference(power, t, policy):
+    norm = normalize_power(power, xp=jnp)
+    return score_normalized_power(norm, t, TSAMP, xp=jnp, policy=policy)
+
+
+def _score_rtol(policy):
+    if policy is None:
+        return 1e-5
+    return max(1e-5, STRATEGIES[policy].score_rtol * 1e-2)
+
+
+def _assert_identity(got, want, policy, t=4096):
+    np.testing.assert_array_equal(np.asarray(got["nharm"]),
+                                  np.asarray(want["nharm"]))
+    # discrete contract: the peak names the same BIN; the frequency
+    # float itself may differ by one ulp across compiled programs
+    # (jit rewrites arange/(t*tsamp) as a reciprocal multiply)
+    scale = t * TSAMP
+    np.testing.assert_array_equal(
+        np.rint(np.asarray(got["freq"], dtype=np.float64) * scale),
+        np.rint(np.asarray(want["freq"], dtype=np.float64) * scale))
+    np.testing.assert_allclose(np.asarray(got["freq"]),
+                               np.asarray(want["freq"]), rtol=1e-6)
+    rtol = _score_rtol(policy)
+    for col in ("power", "log_sf", "sigma"):
+        np.testing.assert_allclose(np.asarray(got[col]),
+                                   np.asarray(want[col]), rtol=rtol,
+                                   atol=1e-6, err_msg=col)
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_host_identity(policy):
+    plane = _plane()
+    t = plane.shape[-1]
+    power = power_spectrum(jnp.asarray(plane), xp=jnp)
+    got = score_power_pallas(power, t, TSAMP, policy=policy,
+                             interpret=True)
+    want = _reference(power, t, policy)
+    _assert_identity(got, want, policy)
+
+
+def test_row_padding_identity():
+    # 13 rows: one full 8-row block + a padded block whose benign
+    # ones-rows must not perturb the real rows
+    plane = _plane(rows=13, seed=23)
+    t = plane.shape[-1]
+    power = power_spectrum(jnp.asarray(plane), xp=jnp)
+    got = score_power_pallas(power, t, TSAMP, interpret=True)
+    want = _reference(power, t, None)
+    _assert_identity(got, want, None)
+    assert np.asarray(got["freq"]).shape == (13,)
+
+
+@pytest.mark.parametrize("policy", [None, "f32_compensated"])
+def test_jit_identity(policy):
+    plane = _plane(seed=31)
+    t = plane.shape[-1]
+
+    @jax.jit
+    def run(p):
+        spec = score_power_pallas(power_spectrum(p, xp=jnp), t, TSAMP,
+                                  policy=policy, interpret=True)
+        return tuple(spec[k] for k in KEYS)
+
+    got = dict(zip(KEYS, run(jnp.asarray(plane))))
+    want = spectral_search(jnp.asarray(plane), TSAMP, xp=jnp,
+                           policy=policy)
+    _assert_identity(got, want, policy)
+
+
+def test_band_limits_identity():
+    plane = _plane(seed=47)
+    t = plane.shape[-1]
+    power = power_spectrum(jnp.asarray(plane), xp=jnp)
+    fmin, fmax = 20.0, 220.0
+    got = score_power_pallas(power, t, TSAMP, fmin=fmin, fmax=fmax,
+                             interpret=True)
+    norm = normalize_power(power, xp=jnp)
+    want = score_normalized_power(norm, t, TSAMP, fmin=fmin, fmax=fmax,
+                                  xp=jnp)
+    _assert_identity(got, want, None)
+
+
+def test_max_harmonics_truncates_depths():
+    plane = _plane(seed=53)
+    t = plane.shape[-1]
+    power = power_spectrum(jnp.asarray(plane), xp=jnp)
+    got = score_power_pallas(power, t, TSAMP, max_harmonics=4,
+                             interpret=True)
+    norm = normalize_power(power, xp=jnp)
+    want = score_normalized_power(norm, t, TSAMP, max_harmonics=4,
+                                  xp=jnp)
+    _assert_identity(got, want, None)
+    assert int(np.asarray(got["nharm"]).max()) <= 4
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("policy", [None, "f32_compensated"])
+def test_mesh_identity(shape, policy):
+    # per-row scoring shards cleanly over rows; the Pallas kernel runs
+    # per shard (check_vma off: pallas_call outputs carry no vma)
+    plane = _plane(rows=16, seed=61)
+    t = plane.shape[-1]
+    mesh = make_mesh(shape, ("dm", "chan"))
+
+    def local(p):
+        spec = score_power_pallas(power_spectrum(p, xp=jnp), t, TSAMP,
+                                  policy=policy, interpret=True)
+        return tuple(spec[k] for k in KEYS)
+
+    fn = shard_map_compat(
+        local, mesh=mesh, in_specs=(P("dm", None),),
+        out_specs=tuple(P("dm") for _ in KEYS), check_vma=False)
+    got = dict(zip(KEYS, jax.jit(fn)(jnp.asarray(plane))))
+    want = spectral_search(jnp.asarray(plane), TSAMP, xp=jnp,
+                           policy=policy)
+    _assert_identity(got, want, policy)
+
+
+def test_spectral_search_pallas_full_chain():
+    plane = _plane(seed=71)
+    got = spectral_search_pallas(plane, TSAMP)
+    want = spectral_search(jnp.asarray(plane), TSAMP, xp=jnp)
+    _assert_identity(got, want, None)
+
+
+def test_spectral_chunk_pallas_kernel_spec():
+    # the production dispatch seam: kernel="pallas" returns the host
+    # dict contract (_SPEC_KEYS, int32 nharm) matching kernel="xla"
+    plane = _plane(seed=83)
+    xla = _spectral_chunk(jnp.asarray(plane), TSAMP, 16, None, None, jnp,
+                          kernel="xla")
+    pal = _spectral_chunk(jnp.asarray(plane), TSAMP, 16, None, None, jnp,
+                          kernel="pallas")
+    assert pal["nharm"].dtype == np.int32
+    _assert_identity(pal, xla, None)
+
+
+def test_spectral_chunk_auto_resolves_static_xla(monkeypatch):
+    # PUTPU_AUTOTUNE=off: "auto" must be the static "xla" — no pallas
+    # dispatch, byte-identical to the explicit spelling
+    monkeypatch.setenv("PUTPU_AUTOTUNE", "off")
+    from pulsarutils_tpu.tuning.autotune import resolve_harmonic_kernel
+
+    assert resolve_harmonic_kernel(16, 4096, TSAMP) == "xla"
+    plane = _plane(seed=97)
+    auto = _spectral_chunk(jnp.asarray(plane), TSAMP, 16, None, None, jnp,
+                           kernel="auto")
+    xla = _spectral_chunk(jnp.asarray(plane), TSAMP, 16, None, None, jnp,
+                          kernel="xla")
+    for k in KEYS:
+        np.testing.assert_array_equal(auto[k], xla[k], err_msg=k)
+
+
+def test_bf16_policy_needs_jax_path():
+    with pytest.raises(ValueError, match="bfloat16"):
+        score_normalized_power(np.ones((2, 64)), 64, TSAMP, xp=np,
+                               policy="bf16_operand_f32_accum")

@@ -516,6 +516,51 @@ def test_float64_leak_silent_outside_device_layers():
     assert lint(src, path=OBS) == []
 
 
+# -- checker: bf16 casts outside the precision seam ---------------------------
+
+def test_bf16_cast_astype_fires_in_ops():
+    src = "import jax.numpy as jnp\ny = x.astype(jnp.bfloat16)\n"
+    assert ids(lint(src, path=OPS)) == ["bf16-cast"]
+
+
+def test_bf16_cast_string_dtype_fires_in_parallel():
+    src = 'import jax.numpy as jnp\ny = jnp.asarray(x, "bfloat16")\n'
+    assert ids(lint(src, path=PAR)) == ["bf16-cast"]
+
+
+def test_bf16_cast_ctor_kwarg_fires():
+    src = ("import jax.numpy as jnp\n"
+           "y = jnp.zeros((4,), dtype=jnp.float16)\n")
+    assert ids(lint(src, path=OPS)) == ["bf16-cast"]
+
+
+def test_bf16_cast_convert_element_type_fires():
+    src = ("import jax\n"
+           "y = jax.lax.convert_element_type(x, jax.numpy.bfloat16)\n")
+    assert ids(lint(src, path=OPS)) == ["bf16-cast"]
+
+
+def test_bf16_dtype_comparison_is_silent():
+    # a dtype *guard* is not a cast
+    src = ("import jax.numpy as jnp\n"
+           "flag = x.dtype == jnp.bfloat16\n")
+    assert lint(src, path=OPS) == []
+
+
+def test_bf16_cast_silent_outside_device_layers():
+    # the precision/ seam (and every non-device layer) may spell bf16
+    src = "import jax.numpy as jnp\ny = x.astype(jnp.bfloat16)\n"
+    assert lint(src, path="pulsarutils_tpu/precision/policy.py") == []
+    assert lint(src, path=OBS) == []
+
+
+def test_bf16_cast_waivable_for_policy_gated_kernel():
+    src = ("import jax.numpy as jnp\n"
+           "y = x.astype(jnp.bfloat16)"
+           "  # putpu-lint: disable=bf16-cast — policy-gated\n")
+    assert lint(src, path=OPS) == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 BROAD = "try:\n    work()\nexcept Exception:\n    pass\n"
@@ -741,7 +786,7 @@ def test_committed_tree_runs_at_least_six_checkers():
     rep = project.report()
     assert rep["clean"]
     assert {"retrace", "device-trip", "lock-discipline", "metric-name",
-            "broad-except", "float64-leak", "atomic-write"} \
+            "broad-except", "float64-leak", "bf16-cast", "atomic-write"} \
         <= set(rep["checkers"])
     assert rep["files"] > 50
 
@@ -772,7 +817,8 @@ def test_cli_list_checkers():
     res = _run_cli("--list-checkers")
     assert res.returncode == 0
     for cid in ("retrace", "device-trip", "lock-discipline",
-                "metric-name", "broad-except", "float64-leak"):
+                "metric-name", "broad-except", "float64-leak",
+                "bf16-cast"):
         assert cid in res.stdout
 
 

@@ -221,11 +221,13 @@ def test_prometheus_conformance_golden():
 #: json.dumps(acct.to_json()) captured on the PRE-refactor accountant
 #: with the same fake clock and operation sequence as the test below.
 #: ISSUE 5 added the leading "schema_version" key, ISSUE 14 the
-#: "chunk_wall_s" percentile block (schema_version 1 -> 2) — both
-#: DELIBERATE byte changes, versioned as such; every other byte is
-#: still pinned.
+#: "chunk_wall_s" percentile block (schema_version 1 -> 2), ISSUE 17
+#: the snapshot header's backend/precision-policy lane stamps
+#: (schema_version 2 -> 3, no BUDGET_JSON byte change beyond the
+#: version) — all DELIBERATE byte changes, versioned as such; every
+#: other byte is still pinned.
 _GOLDEN_BUDGET_JSON = (
-    '{"schema_version": 2, '
+    '{"schema_version": 3, '
     '"chunks": 2, "wall_s": 1.125, '
     '"chunk_wall_s": {"p50": 0.5625, "p95": 0.5625, "p99": 0.5625}, '
     '"buckets_s": {"search": 0.625, '
@@ -646,6 +648,75 @@ def test_gate_cli_rejects_unversioned_snapshot(tmp_path):
         capture_output=True, text=True)
     assert proc.returncode == 2, proc.stdout + proc.stderr
     assert "schema_version" in proc.stderr
+
+
+def test_gate_cli_refuses_cross_lane_snapshot(tmp_path):
+    # ISSUE 17: the v3 header stamps the bench LANE (JAX backend +
+    # precision policy); the CLI must exit 2 — refuse, not score — when
+    # a snapshot from another lane is compared against the cpu baseline
+    baseline = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
+    hdr = gate.load_header(baseline)
+    assert hdr.get("backend") == "cpu"
+    assert hdr.get("precision_policy") == "f32"
+    records = gate.load_snapshot(baseline)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for key, val in (("backend", "tpu"),
+                     ("precision_policy", "bf16_operand_f32_accum")):
+        doctored = str(tmp_path / f"{key}.jsonl")
+        with open(doctored, "w") as f:
+            f.write(json.dumps(dict(hdr, **{key: val})) + "\n")
+            for rec in records.values():
+                f.write(json.dumps(rec) + "\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+             "--snapshot", doctored], env=env, cwd=REPO,
+            capture_output=True, text=True)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert f"{key} mismatch" in proc.stderr
+    # --backend resolves the per-backend baseline file: an absent lane
+    # baseline is a usage error naming the resolved path, not a
+    # fall-through to another lane's numbers
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--backend", "tpu", "--snapshot", baseline], env=env, cwd=REPO,
+        capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "BENCH_GATE_tpu.jsonl" in proc.stderr
+    # and a baseline explicitly from ANOTHER lane than --backend asks
+    # for is refused up front
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--backend", "tpu", "--baseline", baseline,
+         "--snapshot", baseline], env=env, cwd=REPO,
+        capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "stamped for backend" in proc.stderr
+
+
+def test_header_mismatch_lane_rules(tmp_path):
+    # unit-level lane semantics: undeclared fields never clash (old
+    # artifacts keep gating), declared-and-different always does
+    assert gate.header_mismatch({}, {}) is None
+    assert gate.header_mismatch({"backend": "cpu"}, {}) is None
+    assert gate.header_mismatch({"backend": "cpu"},
+                                {"backend": "cpu"}) is None
+    assert "backend mismatch" in gate.header_mismatch(
+        {"backend": "cpu"}, {"backend": "tpu"})
+    assert "precision_policy mismatch" in gate.header_mismatch(
+        {"backend": "cpu", "precision_policy": "f32"},
+        {"backend": "cpu", "precision_policy": "f32_compensated"})
+    # load_header: header line parsed; header-less snapshot reads as {}
+    p = str(tmp_path / "h.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"schema_version": gate.SCHEMA_VERSION,
+                            "backend": "cpu",
+                            "precision_policy": "f32"}) + "\n")
+        f.write(json.dumps({"config": 1, "value": 1.0}) + "\n")
+    assert gate.load_header(p)["backend"] == "cpu"
+    bare = str(tmp_path / "bare.jsonl")
+    with open(bare, "w") as f:
+        f.write(json.dumps({"config": 1, "value": 1.0}) + "\n")
+    assert gate.load_header(bare) == {}
 
 
 def test_budget_json_carries_schema_version():

@@ -32,6 +32,8 @@ MODULES = [
     "pulsarutils_tpu.ops.robust",
     "pulsarutils_tpu.ops.rebin",
     "pulsarutils_tpu.ops.periodicity",
+    "pulsarutils_tpu.ops.harmonic_pallas",
+    "pulsarutils_tpu.precision.policy",
     "pulsarutils_tpu.models.simulate",
     "pulsarutils_tpu.pipeline.search_pipeline",
     "pulsarutils_tpu.pipeline.spectral_stats",

@@ -22,6 +22,13 @@ configs it covers — any config that emits a value record works (config
     python tools/perf_gate.py --baseline BENCH_GATE_tpu.jsonl \
         --configs 1 6 7 --preset full
 
+Per-backend bench lanes (ISSUE 17): ``--backend NAME`` resolves the
+baseline to ``BENCH_GATE_<NAME>.jsonl``, and the v3 snapshot header's
+``backend``/``precision_policy`` lane stamps must agree between the
+baseline and the fresh snapshot — the gate exits 2 instead of comparing
+walls measured on different backends or under different accumulation
+precision policies (``PUTPU_PRECISION``).
+
 PASS also requires the static-invariant gate: putpu-lint must report
 zero new findings (run in-process by default; point ``--lint-report``
 at a pre-generated ``putpu_lint.py --out`` JSON artifact to check that
@@ -80,10 +87,15 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: acceleration-backend A/B — its value drops to 0.0 when either the
 #: time_stretch or the fdas backend's top candidate misses the
 #: injected (DM, P, accel, jerk) cell at matched trial grids or the
-#: two tables fail the cross-backend equivalence harness; all
-#: thirteen run in tier-1-scale time)
-DEFAULT_BASELINE = os.path.join(REPO, "BENCH_GATE_cpu.jsonl")
-DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20)
+#: two tables fail the cross-backend equivalence harness; 21: the
+#: precision-policy A/B — its value drops to 0.0 when the bf16-operand
+#: arm's best candidate diverges from the f32 arm in any discrete
+#: field or its dedispersed profile violates the strategy's documented
+#: error bound against a float64 oracle; all fourteen run in
+#: tier-1-scale time)
+DEFAULT_BASELINE_FMT = os.path.join(REPO, "BENCH_GATE_{backend}.jsonl")
+DEFAULT_BASELINE = DEFAULT_BASELINE_FMT.format(backend="cpu")
+DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21)
 
 #: the committed tune-cache artifact the gate version-checks (the
 #: snapshot-schema rule of PR 5, applied to tuner measurements: a
@@ -143,10 +155,14 @@ DEFAULT_TUNE_ARTIFACT = os.path.join(REPO, "TUNE_cpu.json")
 #: gated signal is the forced 0.0 on a missed injected (DM, P, accel,
 #: jerk) cell or a cross-backend table-harness failure, so the
 #: wall-clock bound applies.
+#: Config 21 (ISSUE 17) is the f32/bf16 wall quotient on the same CPU
+#: gather sweep — two jittery walls whose gated signal is the forced
+#: 0.0 on a discrete-field divergence or an error-bound violation
+#: against the float64 oracle, so the wall-clock bound applies.
 #: Config 10 stays TIGHT: canary recall is deterministic, not jittery.
 DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75, 13: 0.75,
                           14: 0.75, 15: 0.75, 16: 0.75, 17: 0.75,
-                          18: 0.75, 19: 0.75, 20: 0.75}
+                          18: 0.75, 19: 0.75, 20: 0.75, 21: 0.75}
 
 
 def run_suite(configs, preset, out_path):
@@ -193,10 +209,17 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         description="compare a fresh bench snapshot against a committed "
                     "baseline; exit 1 on regression")
-    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+    parser.add_argument("--baseline", default=None,
                         help="committed snapshot (JSON lines with "
-                             "config/value records); default "
-                             "BENCH_GATE_cpu.jsonl")
+                             "config/value records); default: the "
+                             "--backend lane's BENCH_GATE_<backend>"
+                             ".jsonl")
+    parser.add_argument("--backend", default="cpu", metavar="NAME",
+                        help="bench lane to gate (default cpu): "
+                             "resolves the committed baseline to "
+                             "BENCH_GATE_<NAME>.jsonl and must match "
+                             "the snapshots' stamped backend — the "
+                             "gate refuses cross-lane comparisons")
     parser.add_argument("--snapshot", default=None,
                         help="pre-captured fresh snapshot; when omitted "
                              "the suite is run (--configs, --preset)")
@@ -228,6 +251,8 @@ def main(argv=None):
                              "schema-check (default TUNE_cpu.json; "
                              "'-' skips, NOT for CI)")
     opts = parser.parse_args(argv)
+    if opts.baseline is None:
+        opts.baseline = DEFAULT_BASELINE_FMT.format(backend=opts.backend)
 
     if not os.path.exists(opts.baseline):
         print(f"perf_gate: baseline {opts.baseline} not found "
@@ -241,6 +266,14 @@ def main(argv=None):
     except ValueError as exc:
         print(f"perf_gate: {exc}", file=sys.stderr)
         return 2
+    base_hdr = gate.load_header(opts.baseline)
+    if base_hdr.get("backend") not in (None, opts.backend):
+        print(f"perf_gate: baseline {opts.baseline} is stamped for "
+              f"backend {base_hdr['backend']!r} but the gate was asked "
+              f"for --backend {opts.backend} — point --baseline at that "
+              "lane's BENCH_GATE_<backend>.jsonl instead",
+              file=sys.stderr)
+        return 2
 
     if opts.snapshot:
         try:
@@ -249,6 +282,7 @@ def main(argv=None):
         except ValueError as exc:
             print(f"perf_gate: {exc}", file=sys.stderr)
             return 2
+        fresh_hdr = gate.load_header(opts.snapshot)
     else:
         fd, fresh_path = tempfile.mkstemp(suffix=".jsonl",
                                           prefix="perf_gate_")
@@ -257,6 +291,7 @@ def main(argv=None):
             run_suite(opts.configs, opts.preset, fresh_path)
             fresh = gate.load_snapshot(fresh_path,
                                        expect_version=gate.SCHEMA_VERSION)
+            fresh_hdr = gate.load_header(fresh_path)
         except subprocess.CalledProcessError as exc:
             print(f"perf_gate: bench suite failed: {exc}", file=sys.stderr)
             return 1
@@ -265,6 +300,14 @@ def main(argv=None):
                 os.unlink(fresh_path)
             except OSError:
                 pass
+
+    # lane rule (ISSUE 17): never compare walls across backends or
+    # precision policies — a cross-lane "comparison" is a category
+    # error, refused as a usage problem rather than scored
+    mismatch = gate.header_mismatch(base_hdr, fresh_hdr)
+    if mismatch:
+        print(f"perf_gate: {mismatch}", file=sys.stderr)
+        return 2
 
     per_config = dict(DEFAULT_PER_CONFIG_TOL)
     per_config.update(parse_tol(opts.tol))
