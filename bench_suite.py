@@ -1809,11 +1809,191 @@ def config21(quick):
           "bf16_wall_s": round(bf16_wall, 3)})
 
 
+def config22(quick):
+    """Candidate-lifecycle A/B (ISSUE 18): the same multi-hit survey
+    run through ``search_by_chunks`` twice —
+
+    * **off arm** — the plain driver (no lineage, no push), the
+      pre-ISSUE-18 path;
+    * **on arm** — lineage recording armed (per-candidate docs + the
+      stage/latency histograms) and alert push fanning every detection
+      out to a local in-process webhook sink, plus one subscriber whose
+      ``min_snr`` filter excludes everything (the negative control).
+
+    ``value`` is the off/on wall ratio (the layer's measured overhead;
+    ~1.0 expected) — FORCED to 0.0, far past any tolerance, when any
+    candidate/ledger byte diverges between the arms, when any persisted
+    hit is missing its lineage doc (or its stage offsets are not
+    monotone), when the sink did not receive every detection, or when
+    the filtered-out subscriber received anything at all.
+    """
+    import glob
+    import http.server
+    import tempfile
+    import threading
+
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+    tsamp, nchan = 0.0005, 64
+    hop = 4096 if quick else 8192
+    nhops = 6
+    nsamples = nhops * hop
+    config = dict(dmmin=100, dmmax=200, backend="jax",
+                  chunk_length=hop * tsamp, snr_threshold=6.5,
+                  make_plots=False, progress=False, resume=True)
+
+    class Sink:
+        def __init__(self):
+            received = self.received = []
+
+            class Handler(http.server.BaseHTTPRequestHandler):
+                def do_POST(self):
+                    n = int(self.headers.get("Content-Length") or 0)
+                    received.append(json.loads(self.rfile.read(n)))
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"{}")
+
+                def log_message(self, *a):
+                    pass
+
+            self.httpd = http.server.ThreadingHTTPServer(
+                ("127.0.0.1", 0), Handler)
+            self.httpd.daemon_threads = True
+            threading.Thread(target=self.httpd.serve_forever,
+                             daemon=True).start()
+            self.url = (f"http://127.0.0.1:"
+                        f"{self.httpd.server_address[1]}/hook")
+
+        def close(self):
+            self.httpd.shutdown()
+            self.httpd.server_close()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.default_rng(220)
+        arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+        # one pulse per interior hop: a MULTI-hit survey, so the sink
+        # count and per-hit doc checks exercise more than one candidate
+        for h in range(1, nhops - 1):
+            arr[:, h * hop + hop // 2] += 4.0
+        arr = disperse_array(arr, 150.0, 1200., 200., tsamp)
+        header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": tsamp,
+                  "foff": 200. / nchan}
+        fname = os.path.join(tmp, "survey.fil")
+        write_simulated_filterbank(fname, arr, header, descending=True)
+
+        sink, control = Sink(), Sink()
+        try:
+            t0 = time.time()
+            hits_off, _store = search_by_chunks(
+                fname, output_dir=os.path.join(tmp, "off"), **config)
+            off_wall = time.time() - t0
+
+            t0 = time.time()
+            hits_on, _store = search_by_chunks(
+                fname, output_dir=os.path.join(tmp, "on"),
+                lineage=True,
+                push=[sink.url,
+                      {"url": control.url, "name": "control",
+                       "min_snr": 1e9}],
+                **config)
+            on_wall = time.time() - t0
+            # the driver-owned broker is closed (drained) at the
+            # driver's tail, so both sinks' lists are settled here
+        finally:
+            sink.close()
+            control.close()
+
+        # identity: ledger + candidate npz bytes between arms
+        # (lineage docs are EXTRA files beside the pair, excluded by
+        # these globs on purpose — the pre-PR artifact set must match)
+        identical = True
+        names = {os.path.basename(p)
+                 for d in ("off", "on")
+                 for p in glob.glob(os.path.join(tmp, d,
+                                                 "progress_*.json"))
+                 + glob.glob(os.path.join(tmp, d, "*.npz"))}
+        for name in sorted(names):
+            a_path = os.path.join(tmp, "off", name)
+            b_path = os.path.join(tmp, "on", name)
+            if not (os.path.exists(a_path) and os.path.exists(b_path)):
+                identical = False
+                log(f"config 22: {name} present in only one arm")
+                continue
+            if name.endswith(".json"):
+                with open(a_path, "rb") as fa, open(b_path, "rb") as fb:
+                    if fa.read() != fb.read():
+                        identical = False
+                        log(f"config 22: ledger bytes differ: {name}")
+            else:
+                with np.load(a_path, allow_pickle=False) as za, \
+                        np.load(b_path, allow_pickle=False) as zb:
+                    if set(za.files) != set(zb.files) or any(
+                            za[k].tobytes() != zb[k].tobytes()
+                            for k in za.files):
+                        identical = False
+                        log(f"config 22: candidate bytes differ: {name}")
+
+        # every persisted hit carries a lineage doc with monotone stages
+        docs_ok = len(hits_on) >= 2
+        if not docs_ok:
+            log(f"config 22: expected a multi-hit survey, got "
+                f"{len(hits_on)} hit(s)")
+        for istart, iend, _info, _tab in hits_on:
+            matches = glob.glob(os.path.join(
+                tmp, "on", f"*_{istart}-{iend}.lineage.json"))
+            if len(matches) != 1:
+                docs_ok = False
+                log(f"config 22: hit {istart}-{iend} has no lineage doc")
+                continue
+            with open(matches[0]) as f:
+                doc = json.load(f)
+            order = [doc["stages"].get(s) for s in
+                     ("read", "dispatch", "ready", "sift", "persist")]
+            if None in order or order != sorted(order):
+                docs_ok = False
+                log(f"config 22: non-monotone stages for hit "
+                    f"{istart}-{iend}: {doc['stages']}")
+
+        delivered_ok = (sorted(a["chunk"] for a in sink.received)
+                        == sorted(h[0] for h in hits_on))
+        if not delivered_ok:
+            log(f"config 22: sink received chunks "
+                f"{sorted(a.get('chunk') for a in sink.received)} vs "
+                f"hits {sorted(h[0] for h in hits_on)}")
+        control_ok = not control.received
+        if not control_ok:
+            log(f"config 22: the filtered-out subscriber received "
+                f"{len(control.received)} alert(s) — filter violated")
+
+        ok = identical and docs_ok and delivered_ok and control_ok
+    emit({"config": 22, "metric": "candidate-lifecycle A/B: lineage + "
+          "alert push armed vs off over a multi-hit survey "
+          f"({nchan}x{nsamples}, in-process webhook sink + filtered "
+          "control subscriber)",
+          "value": round(off_wall / on_wall, 4) if ok else 0.0,
+          "unit": "x (off/on wall; 0 = byte divergence, missing "
+                  "lineage docs, or a filter violation)",
+          "identical": identical,
+          "lineage_docs_ok": bool(docs_ok),
+          "delivered_ok": bool(delivered_ok),
+          "control_clean": bool(control_ok),
+          "hits": len(hits_on),
+          "alerts_delivered": len(sink.received),
+          "off_wall_s": round(off_wall, 2),
+          "on_wall_s": round(on_wall, 2)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
-                                 13, 14, 15, 16, 17, 18, 19, 20, 21])
+                                 13, 14, 15, 16, 17, 18, 19, 20, 21,
+                                 22])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -1848,7 +2028,7 @@ def main(argv=None):
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
            15: config15, 16: config16, 17: config17, 18: config18,
-           19: config19, 20: config20, 21: config21}
+           19: config19, 20: config20, 21: config21, 22: config22}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

@@ -45,6 +45,10 @@ CONTAINMENT_SEAMS = {
     ("obs/roofline.py", "_analyze"),        # AOT lower/compile probe
     ("obs/roofline.py", "_peaks"),          # backend probe
     ("obs/memory.py", "device_memory_snapshot"),
+    # alert fan-out is observability-only (ISSUE 18): a dead webhook,
+    # a failing lineage hook or a full disk must be counted,
+    # dead-lettered and contained — never raised into the search loop
+    ("obs/push.py", ""),
     # -- capability probes: failure == feature absent ----------------------
     ("utils/logging_utils.py", "_install_compile_listener"),
     ("utils/logging_utils.py", "measure_device_rtt"),

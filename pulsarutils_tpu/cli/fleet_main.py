@@ -126,6 +126,24 @@ def build_parser():
                       help="sample this worker's registry every S "
                            "seconds; serves /metrics/history, which "
                            "the coordinator scrapes for fleet trends")
+    work.add_argument("--lineage", action="store_true",
+                      help="stamp every hit this worker persists with "
+                           "a candidate lineage record (stage "
+                           "timestamps + the lease's trace id) beside "
+                           "the candidate npz pair.  Worker-local: "
+                           "never part of the lease config, so the "
+                           "ledger fingerprint is unchanged")
+    work.add_argument("--push-webhook", action="append", default=None,
+                      metavar="URL",
+                      help="POST every detection this worker makes to "
+                           "this webhook URL (repeatable).  Bounded "
+                           "background delivery — a dead webhook never "
+                           "stalls the unit loop; delivery counters "
+                           "ride each completion to the coordinator's "
+                           "/fleet/metrics")
+    work.add_argument("--push-dead-letter", default=None, metavar="PATH",
+                      help="journal undeliverable alerts to this JSONL "
+                           "file (default: drop with a counter)")
     return parser
 
 
@@ -250,7 +268,11 @@ def _run_worker(opts):
                          http_host=opts.http_host,
                          max_units=opts.max_units,
                          trace=bool(opts.trace_out),
-                         history_interval_s=opts.history_interval)
+                         history_interval_s=opts.history_interval,
+                         lineage=opts.lineage,
+                         push=(list(opts.push_webhook)
+                               if opts.push_webhook else None),
+                         push_dead_letter_path=opts.push_dead_letter)
     worker.install_signal_handlers()
     units = worker.run(max_idle_s=opts.max_idle)
     if opts.trace_out and worker.tracer is not None:

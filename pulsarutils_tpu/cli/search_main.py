@@ -146,6 +146,31 @@ def build_parser():
                              "range)")
     parser.add_argument("--canary-snr", type=float, default=12.0,
                         help="canary target S/N (default 12)")
+    parser.add_argument("--lineage", action="store_true",
+                        help="stamp every detection with a candidate "
+                             "lineage record (trace id + monotonic "
+                             "stage timestamps: read, dispatch, device "
+                             "ready, sift, persist, alert), persisted "
+                             "as <candidate>.lineage.json beside the "
+                             "npz pair and driving the candidate-"
+                             "latency SLO.  Default off, byte-inert")
+    parser.add_argument("--push-webhook", action="append", default=None,
+                        metavar="URL",
+                        help="POST every detection to this webhook URL "
+                             "(repeatable: one subscriber per flag).  "
+                             "Delivery runs on a bounded background "
+                             "queue — a slow or dead webhook never "
+                             "stalls the search; undeliverable alerts "
+                             "are journaled to push_dead_letter_"
+                             "<fingerprint>.jsonl in the output dir.  "
+                             "More subscribers (with min-S/N / DM-range "
+                             "filters) can join a live run via POST "
+                             "/subscribe on --http-port")
+    parser.add_argument("--push-min-snr", type=float, default=None,
+                        metavar="SNR",
+                        help="only push detections at or above this "
+                             "S/N (applies to every --push-webhook "
+                             "subscriber)")
     parser.add_argument("--report-out", default=None, metavar="PATH",
                         help="write the end-of-run survey report "
                              "(PATH.md + self-contained PATH.html: "
@@ -204,6 +229,12 @@ def main(args=None):
 
             root = _os.path.splitext(_os.path.basename(str(fname)))[0]
             report_out = f"{report_out}.{root}"
+        push = None
+        if opts.push_webhook:
+            push = [{"url": url,
+                     **({"min_snr": opts.push_min_snr}
+                        if opts.push_min_snr is not None else {})}
+                    for url in opts.push_webhook]
         hits, _ = search_by_chunks(
             fname,
             chunk_length=opts.chunk_length,
@@ -232,6 +263,8 @@ def main(args=None):
             http_host=opts.http_host,
             canary=canary,
             report_out=report_out,
+            lineage=opts.lineage,
+            push=push,
         )
         total_raw += len(hits)
         if hits and not opts.no_sift:

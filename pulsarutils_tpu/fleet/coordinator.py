@@ -1331,6 +1331,19 @@ class FleetCoordinator:
             history = {w.id: self._compact_history(w.history)
                        for w in self._workers.values()
                        if w.history is not None}
+            # alert-delivery rollup (ISSUE 18): the putpu_push_* family
+            # rides each completion's metrics snapshot — sum it across
+            # workers so the fleet record answers "did every detection
+            # reach its webhooks" without scraping N workers.  Absent
+            # when no worker pushed anything (byte-inert off).
+            push = {}
+            for w in self._workers.values():
+                for rec in (w.metrics or ()):
+                    name = rec.get("name", "")
+                    if name.startswith("putpu_push_") \
+                            and rec.get("type") == "counter" \
+                            and rec.get("value"):
+                        push[name] = push.get(name, 0) + rec["value"]
         out = {"chunks_total": doc["chunks_total"],
                "chunks_done": doc["chunks_done"],
                "units": doc["units"], "stats": doc["stats"],
@@ -1343,6 +1356,8 @@ class FleetCoordinator:
             # chunks/s, headroom and recall over time, not just finals
             out["history"] = {k: v for k, v in sorted(history.items())
                               if v}
+        if push:
+            out["push"] = {k: push[k] for k in sorted(push)}
         return out
 
     @property

@@ -91,12 +91,23 @@ class FleetWorker:
     the metric time-series sampler behind ``/metrics/history``, which
     the coordinator's sweep scrapes for the fleet report's per-worker
     trends.
+
+    Candidate lifecycle knobs (ISSUE 18, also worker-local — they ride
+    ``search_overrides``' host-local lane, never the lease config, so
+    the ledger fingerprint is untouched): ``lineage=True`` stamps every
+    hit this worker persists with a lineage doc (the driver's
+    ``lineage=`` knob per unit); ``push`` is an
+    :class:`~pulsarutils_tpu.obs.push.AlertBroker` or a list of
+    subscriber specs — one worker-lifetime broker fans detections out
+    to webhooks, its delivery counters riding each ``complete``'s
+    metrics snapshot to the coordinator's ``/fleet/metrics``.
     """
 
     def __init__(self, coordinator_url, *, worker_id=None, http_port=0,
                  http_host="127.0.0.1", max_units=1, poll_s=None,
                  health=None, search_overrides=None, trace=False,
-                 history_interval_s=None):
+                 history_interval_s=None, lineage=False, push=None,
+                 push_dead_letter_path=None):
         self.coordinator_url = coordinator_url.rstrip("/")
         self.requested_id = worker_id
         self.worker_id = None           # assigned at register
@@ -129,6 +140,23 @@ class FleetWorker:
         #: coordinator's sweep scrapes
         self.history_interval_s = history_interval_s
         self.sampler = None
+        #: candidate lifecycle (ISSUE 18): per-unit lineage docs and a
+        #: worker-lifetime alert broker.  A passed AlertBroker stays
+        #: caller-owned; a spec list builds one owned here (closed —
+        #: bounded — in run()'s finally).
+        self.lineage = bool(lineage)
+        self.push = None
+        self._push_owned = False
+        if push is not None:
+            from ..obs.push import AlertBroker
+
+            if isinstance(push, AlertBroker):
+                self.push = push
+            else:
+                self.push = AlertBroker(
+                    push, health=self.engine,
+                    dead_letter_path=push_dead_letter_path)
+                self._push_owned = True
 
     # -- drain ----------------------------------------------------------------
 
@@ -183,7 +211,8 @@ class FleetWorker:
                 self._server = start_obs_server(
                     self.http_port, health=self.engine,
                     progress_fn=self._progress_snapshot,
-                    host=self.http_host, timeseries=self.sampler)
+                    host=self.http_host, timeseries=self.sampler,
+                    push=self.push)
             healthz_url = (f"http://{self.http_host}:"
                            f"{self._server.port}/healthz")
         from ..resilience.memory_budget import device_budget_bytes
@@ -381,7 +410,14 @@ class FleetWorker:
                 # this lease is stolen — are refused, so a partitioned
                 # zombie can never clobber live output.  Absent on an
                 # old coordinator: unfenced, the pre-epoch behaviour.
-                fence=lease.get("epoch"), **config)
+                fence=lease.get("epoch"),
+                # candidate lifecycle (ISSUE 18): worker-local knobs —
+                # lineage docs per persisted hit, detections fanned out
+                # through the worker-lifetime broker (the driver never
+                # closes a passed broker)
+                **({"lineage": True} if self.lineage else {}),
+                **({"push": self.push} if self.push is not None else {}),
+                **config)
             return None
         except Exception as exc:
             logger.error("fleet worker %s: unit %s failed (%r)",
@@ -586,6 +622,15 @@ class FleetWorker:
                     self.worker_id or "<unregistered>", self.units_done)
             if tracer_token is not None:
                 _trace.pop_tracer(tracer_token)
+            if self.push is not None and self._push_owned:
+                # bounded: a wedged webhook must not stall worker exit
+                # (undelivered alerts are journaled to the dead-letter
+                # file inside close())
+                import json as _json
+
+                logger.info("fleet worker %s: PUSH_JSON %s",
+                            self.worker_id or "<unregistered>",
+                            _json.dumps(self.push.close()))
             if self.sampler is not None:
                 self.sampler.stop()
             if self._server is not None:

@@ -247,6 +247,22 @@ class CandidateStore:
         self.fenced_write(base, write)
         return base
 
+    def save_lineage(self, root, istart, iend, doc):
+        """Persist a candidate's lineage doc beside its npz pair
+        (ISSUE 18): ``{base}.lineage.json``, atomic, under the same
+        epoch fence as the candidate artifacts — a zombie's stale
+        lineage can no more clobber the new owner's than its npz can.
+        Only called when lineage is armed; off-path runs never touch
+        this, so their output directories are byte-identical."""
+        base = self._base(root, istart, iend)
+
+        def write():
+            atomic_write_json(base + ".lineage.json", doc, indent=2,
+                              sort_keys=True, trailing_newline=True)
+
+        self.fenced_write(base, write)
+        return base + ".lineage.json"
+
     # -- the artifact fence (ISSUE 15) ---------------------------------------
 
     def fenced_write(self, path, write_fn):

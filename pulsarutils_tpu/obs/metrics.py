@@ -260,14 +260,27 @@ class MetricsRegistry:
                 f.write(json.dumps(rec) + "\n")
         return len(snap)
 
-    def prometheus_text(self):
+    def prometheus_text(self, manifest_help=False):
+        """Prometheus text exposition.  ``manifest_help=True`` (the live
+        ``/metrics`` scrape, ISSUE 18) additionally serves the
+        :data:`~.names.METRIC_NAMES` one-liner as HELP for any
+        instrument created without one, and routes every emitted
+        ``putpu_*`` name through :func:`~.names.warn_unknown` so an
+        undeclared series surfaces in the log exactly once instead of
+        scrolling past in a dashboard."""
         seen_header = set()
         lines = []
         for (name, _labels), m in self._items():
             if name not in seen_header:
                 seen_header.add(name)
-                if m.help:
-                    lines.append(f"# HELP {name} {_escape_help(m.help)}")
+                help_text = m.help
+                if manifest_help:
+                    _names.warn_unknown(name)
+                    if not help_text:
+                        help_text = _names.METRIC_NAMES.get(name, "")
+                if help_text:
+                    lines.append(
+                        f"# HELP {name} {_escape_help(help_text)}")
                 lines.append(f"# TYPE {name} {m.kind}")
             lines.extend(m._prom_lines())
         return "\n".join(lines) + "\n"

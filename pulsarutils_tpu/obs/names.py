@@ -83,6 +83,12 @@ METRIC_NAMES = {
         "chunk best rows tagged as the canary and excluded",
     "putpu_canary_window_recall":
         "recall over the rolling canary window",
+    "putpu_candidate_latency_seconds":
+        "histogram of end-to-end candidate latency, sample read to "
+        "persist complete (the candidate-latency p95 SLO's source)",
+    "putpu_candidate_stage_seconds":
+        "histogram of per-stage candidate latency (labelled by stage: "
+        "read/dispatch/device/sift/persist/alert)",
     "putpu_certified_chunks_total":
         "chunks whose hybrid noise certificate held",
     "putpu_chunks_per_s":
@@ -180,6 +186,8 @@ METRIC_NAMES = {
         "service jobs reaching a terminal state (labelled by status)",
     "putpu_jobs_submitted_total":
         "jobs accepted by the survey service",
+    "putpu_lineage_docs_total":
+        "per-candidate lineage documents persisted beside the npz",
     "putpu_metric_history_samples_total":
         "time-series ring-buffer samples taken over the registry",
     "putpu_lowbit_bytes_saved_total":
@@ -249,6 +257,21 @@ METRIC_NAMES = {
         "by policy)",
     "putpu_persist_retries_total":
         "candidate persists re-attempted after OSError",
+    "putpu_push_dead_letter_total":
+        "alert deliveries abandoned after retries and journaled to the "
+        "push dead-letter file (labelled by subscriber)",
+    "putpu_push_delivered_total":
+        "candidate alerts delivered to a subscriber webhook (labelled "
+        "by subscriber)",
+    "putpu_push_delivery_seconds":
+        "histogram of successful alert-delivery wall seconds",
+    "putpu_push_dropped_total":
+        "queued alerts evicted drop-oldest when the bounded push queue "
+        "overflowed (a slow or dead subscriber, never backpressure)",
+    "putpu_push_filtered_total":
+        "alert/subscriber pairs skipped by min-S/N / DM filters",
+    "putpu_push_subscribers":
+        "webhook subscribers currently registered on the broker",
     "putpu_quarantine_records_total":
         "records appended to the quarantine manifest",
     "putpu_read_retries_total":

@@ -46,7 +46,7 @@ def _fmt(v, nd=3):
 def build_report(*, meta=None, budget=None, roofline=None, health=None,
                  canary=None, quarantine=None, sift=None, metrics=None,
                  coincidence=None, fleet=None, periodicity=None,
-                 slo=None):
+                 slo=None, lineage=None, push=None):
     """Assemble the structured report record (JSON-ready).
 
     ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
@@ -63,7 +63,10 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
     any, ISSUE 14); ``periodicity``: the periodicity driver's
     ``PERIOD_JSON`` summary plus its folded candidate rows (ISSUE 13);
     ``slo``: ``SLOEngine.to_json()`` — the "SLOs & alerts" section
-    (ISSUE 14).
+    (ISSUE 14); ``lineage``: ``LineageRecorder.summary()`` — the
+    "Candidate latency" per-stage waterfall (ISSUE 18); ``push``:
+    ``AlertBroker.stats()`` — the "Alert push" delivery table
+    (ISSUE 18).
     """
     rec = {
         "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -78,6 +81,8 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
         "fleet": fleet,
         "periodicity": periodicity,
         "slo": slo,
+        "lineage": lineage,
+        "push": push,
     }
     if metrics:
         totals = {}
@@ -310,6 +315,51 @@ def render_markdown(rec):
         lines.append("No sift telemetry (single-candidate run or sift "
                      "skipped).")
     lines.append("")
+
+    lines.append("## Candidate latency")
+    lines.append("")
+    lineage = rec.get("lineage")
+    if lineage and lineage.get("candidates"):
+        lat = lineage.get("latency") or {}
+        lines.append(
+            f"{lineage['candidates']} candidate(s) carried lineage "
+            "records; end-to-end detection-to-persist latency p50/p95/"
+            f"max: **{_fmt(lat.get('p50'))}s / {_fmt(lat.get('p95'))}s "
+            f"/ {_fmt(lat.get('max'))}s** (the candidate-latency SLO's "
+            "indicator).")
+        lines.append("")
+        stages = lineage.get("stages") or {}
+        if stages:
+            lines.append("Per-stage waterfall (seconds each candidate "
+                         "spent between lifecycle seams):")
+            lines.append("")
+            lines.append(_md_table(
+                ("stage", "n", "p50", "p95", "max"),
+                [(s, st["n"], _fmt(st["p50"]), _fmt(st["p95"]),
+                  _fmt(st["max"]))
+                 for s, st in stages.items()]))
+        lines.append("")
+    else:
+        lines += ["Lineage recording was off (or no candidate crossed "
+                  "the threshold): per-candidate latency was NOT "
+                  "measured for this run.", ""]
+
+    lines.append("## Alert push")
+    lines.append("")
+    push = rec.get("push")
+    if push:
+        lines.append(
+            f"{push.get('subscribers', 0)} subscriber(s); "
+            f"{push.get('published', 0)} alert(s) published, "
+            f"**{push.get('delivered', 0)} delivered**, "
+            f"{push.get('filtered', 0)} filtered by subscriber "
+            f"predicates, {push.get('dropped', 0)} dropped "
+            f"(queue overflow), {push.get('dead_lettered', 0)} "
+            "dead-lettered (journaled for replay).")
+        lines.append("")
+    else:
+        lines += ["Alert push was off: no webhook fan-out this run.",
+                  ""]
 
     lines.append("## Cross-beam coincidence")
     lines.append("")

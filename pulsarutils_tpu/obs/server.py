@@ -70,12 +70,19 @@ class _Handler(BaseHTTPRequestHandler):
             path, _, query = self.path.partition("?")
             path = path.rstrip("/") or "/"
             if path == "/metrics":
-                self._send(200, srv.registry.prometheus_text(),
+                # manifest_help: a scrape serves the names-manifest HELP
+                # text for every declared name and flags undeclared
+                # putpu_* names via warn_unknown (once per name) —
+                # ISSUE 18's "/metrics tells you what each series means"
+                self._send(200,
+                           srv.registry.prometheus_text(manifest_help=True),
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/metrics/history":
                 self._get_history(srv, query)
             elif path == "/alerts":
                 self._get_alerts(srv)
+            elif path == "/subscribers":
+                self._get_subscribers(srv)
             elif path == "/healthz":
                 doc = srv.health_snapshot()
                 status = 503 if doc["status"] == "CRITICAL" else 200
@@ -91,7 +98,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/":
                 self._send(200, "pulsarutils_tpu live survey surface: "
                            "/metrics /metrics/history /alerts /healthz "
-                           "/progress /jobs /fleet\n",
+                           "/progress /jobs /fleet /subscribers\n",
                            "text/plain")
             else:
                 self._send(404, "not found\n", "text/plain")
@@ -126,6 +133,17 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, json.dumps(srv.slo.alerts_doc(), indent=1),
                    "application/json")
+
+    def _get_subscribers(self, srv):
+        """GET /subscribers: the alert broker's registered webhook list
+        (ISSUE 18) — the read mirror of ``POST /subscribe``."""
+        if srv.push is None:
+            self._send(404, "no alert broker wired (start the server "
+                       "with push=AlertBroker(...))\n", "text/plain")
+            return
+        self._send(200, json.dumps(
+            {"subscribers": srv.push.subscribers_doc(),
+             "stats": srv.push.stats()}, indent=1), "application/json")
 
     def _get_jobs(self, srv, path):
         """GET /jobs (list) and /jobs/<id> (one document)."""
@@ -204,6 +222,24 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, json.dumps(doc), "application/json")
 
+    def _post_subscribe(self, srv):
+        """POST /subscribe: register an alert-push webhook at runtime
+        (ISSUE 18).  Body: ``{"url": ..., "name": ..., "min_snr": ...,
+        "min_dm": ..., "max_dm": ...}``.  Bad specs (``ValueError``
+        from :meth:`~.push.AlertBroker.subscribe`) map to 400 with the
+        message in the body, same convention as the fleet protocol."""
+        if srv.push is None:
+            self._send(404, "no alert broker wired (start the server "
+                       "with push=AlertBroker(...))\n", "text/plain")
+            return
+        try:
+            doc = srv.push.subscribe(self._read_body())
+        except ValueError as exc:
+            self._send(400, json.dumps({"error": str(exc)}),
+                       "application/json")
+            return
+        self._send(201, json.dumps(doc), "application/json")
+
     def do_POST(self):  # noqa: N802 — http.server API
         """The job-submission API (ISSUE 8): ``POST /jobs`` with a JSON
         body ``{"fname": ..., "dmmin": ..., "dmmax": ..., ...}``
@@ -216,6 +252,9 @@ class _Handler(BaseHTTPRequestHandler):
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
             if path.startswith("/fleet"):
                 self._post_fleet(srv, path)
+                return
+            if path == "/subscribe":
+                self._post_subscribe(srv)
                 return
             if srv.service is None:
                 self._send(404, "no job service wired\n", "text/plain")
@@ -258,8 +297,12 @@ class ObsServer:
 
     def __init__(self, port=0, health=None, progress_fn=None,
                  registry=None, host="127.0.0.1", service=None,
-                 fleet=None, timeseries=None, slo=None):
+                 fleet=None, timeseries=None, slo=None, push=None):
         self.health = health
+        #: a :class:`~.push.AlertBroker` (or None): wired, the surface
+        #: grows POST /subscribe (register a webhook at runtime) and
+        #: GET /subscribers (the registered list + delivery stats)
+        self.push = push
         self.progress_fn = progress_fn
         #: a :class:`~.timeseries.TimeSeriesSampler` (or None): wired,
         #: GET /metrics/history serves the ring-buffer history
@@ -324,7 +367,7 @@ class ObsServer:
 
 def start_obs_server(port, health=None, progress_fn=None, registry=None,
                      host="127.0.0.1", service=None, fleet=None,
-                     timeseries=None, slo=None):
+                     timeseries=None, slo=None, push=None):
     """Start the live surface; returns the :class:`ObsServer` handle
     (``handle.port`` holds the bound port — pass ``port=0`` for an
     ephemeral one).  ``host`` is the bind address: the loopback default
@@ -341,7 +384,11 @@ def start_obs_server(port, health=None, progress_fn=None, registry=None,
     ``GET /metrics/history``; ``slo`` (a
     :class:`~pulsarutils_tpu.obs.slo.SLOEngine`) serves ``GET
     /alerts`` (ISSUE 14) — both read-only views over telemetry the
-    wired objects already hold."""
+    wired objects already hold.  ``push`` (a
+    :class:`~pulsarutils_tpu.obs.push.AlertBroker`) serves ``POST
+    /subscribe`` + ``GET /subscribers`` (ISSUE 18) so an operator can
+    point a webhook at a running survey without restarting it."""
     return ObsServer(port=port, health=health, progress_fn=progress_fn,
                      registry=registry, host=host, service=service,
-                     fleet=fleet, timeseries=timeseries, slo=slo)
+                     fleet=fleet, timeseries=timeseries, slo=slo,
+                     push=push)

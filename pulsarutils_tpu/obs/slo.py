@@ -184,12 +184,13 @@ class Alert:
 
 
 def default_slos(*, chunk_wall_p95_s=60.0, recall_floor=0.7,
-                 dispatch_objective=0.95, lease_objective=0.9):
-    """The framework's stock SLO set (ISSUE 14): dispatch success,
-    chunk-wall p95, the canary recall floor, and fleet lease success.
-    Bounds are constructor knobs — a deployment tunes them per
-    hardware; the defaults are deliberately loose (the engine flags
-    budget *burn*, not scheduler noise)."""
+                 dispatch_objective=0.95, lease_objective=0.9,
+                 candidate_latency_p95_s=30.0):
+    """The framework's stock SLO set (ISSUE 14/18): dispatch success,
+    chunk-wall p95, the canary recall floor, fleet lease success, and
+    end-to-end candidate latency p95.  Bounds are constructor knobs — a
+    deployment tunes them per hardware; the defaults are deliberately
+    loose (the engine flags budget *burn*, not scheduler noise)."""
     return [
         SLOSpec("dispatch-success", objective=dispatch_objective,
                 kind="ratio", bad="putpu_dispatch_retries_total",
@@ -213,6 +214,14 @@ def default_slos(*, chunk_wall_p95_s=60.0, recall_floor=0.7,
                 total="putpu_fleet_leases_granted_total",
                 description="granted leases that resolve without "
                             "expiring (a silent worker burns these)"),
+        SLOSpec("candidate-latency-p95", objective=0.9,
+                kind="threshold",
+                series="putpu_candidate_latency_seconds", field="p95",
+                bound=candidate_latency_p95_s, op="<=",
+                description="p95 end-to-end candidate latency (sample "
+                            "read to persist complete, the lineage "
+                            "histogram) stays under the real-time "
+                            "alerting bound — ISSUE 18"),
     ]
 
 

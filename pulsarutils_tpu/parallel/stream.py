@@ -104,7 +104,7 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   kernel="auto", dispatch_timeout=None, dispatch_retries=0,
                   skip_failed=False, health=None, http_port=None,
                   http_host="127.0.0.1", canary=None,
-                  plane_consumer=None):
+                  plane_consumer=None, lineage=None, push=None):
     """Search an iterable of ``(istart, (nchan, step))`` chunks.
 
     One compiled executable serves every distinct chunk shape; interior
@@ -180,8 +180,19 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     dedispersed plane (device array, or a sharded handle on the mesh
     route) before it is dropped — the periodicity accumulation seam.
     ``None`` (default) keeps the pre-seam code path byte-identical.
+
+    ``lineage`` / ``push`` (ISSUE 18, same contract as
+    ``search_by_chunks``): lineage stamps each hit with monotone stage
+    timestamps and feeds the candidate latency histograms — a stream
+    has no persist store, so the hit-emit point is its "persist
+    complete" stage and no ``.lineage.json`` doc is written; ``push``
+    (an :class:`~pulsarutils_tpu.obs.push.AlertBroker` or subscriber
+    specs) fans hits out to webhook subscribers on a bounded queue
+    that can never block this loop.  Canary best rows are excluded
+    before the publish site.  Both ``None``-gated, byte-identical off.
     """
     import contextlib
+    import json as _json
     import time as _time
 
     from ..faults import inject as fault_inject
@@ -190,6 +201,8 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
     from ..obs import metrics as _metrics
     from ..obs.canary import CanaryController
     from ..obs.health import HealthEngine
+    from ..obs.lineage import LineageRecorder
+    from ..obs.push import AlertBroker
     from ..obs.server import start_obs_server
     from ..obs.trace import set_track, span
     from ..resilience import ladder as _ladder
@@ -292,6 +305,16 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
         canary = None
     if http_port is not None and health is None:
         health = HealthEngine()
+    if lineage is True:
+        lineage = LineageRecorder(source="stream_search")
+    elif not lineage:
+        lineage = None          # accept False/0/"" as "off" (CLI flag)
+    push_owned = False
+    if not push:
+        push = None
+    elif not isinstance(push, AlertBroker):
+        push = AlertBroker(push, health=health)
+        push_owned = True
 
     results = []
     hits = []
@@ -313,7 +336,7 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
 
     obs_server = (start_obs_server(http_port, health=health,
                                    progress_fn=_progress_snapshot,
-                                   host=http_host)
+                                   host=http_host, push=push)
                   if http_port is not None else None)
 
     def _oom_events_total():
@@ -333,6 +356,34 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                           canary=canary.summary()
                           if canary is not None else None)
 
+    def _emit_candidate(istart, chunk, best):
+        """Lineage + push at a hit-append site (ISSUE 18; canary best
+        rows are tagged/promoted before this point and never reach
+        it).  A stream has no persist store, so the emit point doubles
+        as the "persist complete" stage: the hit is durable in the
+        caller's hands and the end-to-end latency histogram closes
+        here."""
+        if lineage is None and push is None:
+            return
+        dm = float(best["DM"])
+        snr = float(best["snr"])
+        width = float(best["rebin"]) * float(sample_time)
+        iend = istart + int(chunk.shape[1])
+        cl = None
+        if lineage is not None:
+            cl = lineage.candidate(istart, iend, dm=dm, snr=snr,
+                                   width=width)
+            lineage.persisted(cl, writer=None)
+        if push is not None:
+            push.publish(
+                {"schema_version": 1, "kind": "candidate",
+                 "source": "stream_search", "chunk": int(istart),
+                 "iend": int(iend), "dm": dm, "snr": snr,
+                 "width_s": width},
+                on_delivered=(None if cl is None else
+                              lambda sub, _lat, _cl=cl:
+                              lineage.delivered(_cl, sub)))
+
     try:
       for istart, chunk in chunks:
         # with a budget, the chunk/search spans come from the accountant
@@ -343,6 +394,10 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
         with ctx:
             t_chunk = _time.perf_counter()
             is_packed = isinstance(chunk, PackedFrames)
+            if lineage is not None:
+                # a stream has no reader thread: chunk receipt is its
+                # "read" seam
+                lineage.mark(istart, "read")
             if canary is not None:
                 if not canary._bound:
                     canary.bind(nchan=chunk.shape[0],
@@ -378,6 +433,8 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                     _metrics.counter(
                         "putpu_lowbit_bytes_saved_total").inc(
                         chunk.float_nbytes - chunk.nbytes)
+            if lineage is not None:
+                lineage.mark(istart, "dispatch")
             try:
                 with (budget.bucket("search") if budget is not None
                       else span("search")):
@@ -398,10 +455,14 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                 _metrics.counter("putpu_stream_chunks_failed_total").inc()
                 if canary is not None:
                     canary.discard(istart)
+                if lineage is not None:
+                    lineage.discard(istart)
                 _health_update(istart,
                                wall_s=_time.perf_counter() - t_chunk,
                                contained=True)
                 continue
+            if lineage is not None:
+                lineage.mark(istart, "ready")
             canary_obs = (canary.observe(istart, table, snr_threshold)
                           if canary is not None else None)
             results.append((istart, table))
@@ -435,6 +496,7 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                             "putpu_stream_hits_total").inc()
                         _metrics.counter(
                             "putpu_canary_promoted_hits_total").inc()
+                        _emit_candidate(istart, chunk, best)
                 else:
                     if canary_obs is not None \
                             and canary_obs["recovered"]:
@@ -451,6 +513,7 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                             istart, canary.dm)
                     hits.append((istart, table, best))
                     _metrics.counter("putpu_stream_hits_total").inc()
+                    _emit_candidate(istart, chunk, best)
             if health is not None:
                 ncand = int(np.count_nonzero(
                     np.asarray(table["snr"], dtype=np.float64)
@@ -461,7 +524,15 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                 _health_update(istart,
                                wall_s=_time.perf_counter() - t_chunk,
                                candidates=ncand)
+            if lineage is not None:
+                # hit lineage froze at the sift verdict; dropping the
+                # chunk marks bounds the recorder's memory
+                lineage.discard(istart)
     finally:
+        if push is not None and push_owned:
+            # bounded drain — a wedged subscriber cannot stall the
+            # stream's exit (undelivered alerts are counted)
+            logger.info("PUSH_JSON %s", _json.dumps(push.close()))
         if obs_server is not None:
             obs_server.close()
     return results, hits

@@ -41,6 +41,8 @@ from ..obs import roofline
 from ..obs.canary import CanaryController
 from ..obs.health import HealthEngine
 from ..obs.server import start_obs_server
+from ..obs.lineage import LineageRecorder
+from ..obs.push import AlertBroker
 from ..obs.trace import begin_span, span as trace_span
 from ..ops.clean_ops import (fft_zap_time, renormalize_data, zero_dm_filter)
 from ..ops.rebin import quick_resample
@@ -411,7 +413,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                      http_port=None, http_host="127.0.0.1", canary=None,
                      health=None, report_out=None, chunks=None,
                      cancel_cb=None, plane_consumer=None,
-                     fingerprint_extra=None, fence=None):
+                     fingerprint_extra=None, fence=None, lineage=None,
+                     push=None):
     """Search a filterbank file for dispersed single pulses.
 
     Parameters follow the reference driver (``clean.py:276``) plus the
@@ -609,6 +612,26 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
       different *workload* over the same file keeps its own resume
       ledger.
 
+    Candidate lifecycle observability (ISSUE 18), both ``None``-gated
+    (off keeps the output directory byte-identical):
+
+    * ``lineage`` — ``True`` (or a
+      :class:`~pulsarutils_tpu.obs.lineage.LineageRecorder`) stamps
+      every hit with monotone stage timestamps (read → dispatch →
+      device ready → sift → persist → alert), persists a
+      ``.lineage.json`` doc beside the candidate npz pair, feeds the
+      ``putpu_candidate_stage_seconds`` /
+      ``putpu_candidate_latency_seconds`` histograms (the
+      candidate-latency p95 SLO) and opens a ``candidate`` span on the
+      chunk's Perfetto track;
+    * ``push`` — an :class:`~pulsarutils_tpu.obs.push.AlertBroker` (or
+      a list of subscriber specs, which builds a driver-owned broker
+      dead-lettering into the output directory and closes it, bounded,
+      at the tail) fans each hit out to webhook subscribers on a
+      bounded-queue daemon thread; a slow or dead subscriber can only
+      fill the queue (drop-oldest, counted), never stall this loop.
+      Canary-tagged rows are excluded before the publish site.
+
     Returns ``(hits, store)`` where hits is a list of
     ``(istart, iend, PulseInfo, ResultTable)``.  NOTE (round 6): when
     plotting is off, a hit's retained/persisted ``info.allprofs`` is the
@@ -717,6 +740,28 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     # run's output directory is byte-identical to pre-hardening
     manifest = QuarantineManifest(output_dir,
                                   fingerprint if resume else None)
+
+    # candidate lifecycle observability (ISSUE 18).  ``lineage=True``
+    # builds a per-run recorder (or pass a LineageRecorder to share one
+    # across files); ``push`` accepts an AlertBroker or a list of
+    # subscriber specs (urls/dicts) — specs build a driver-owned broker
+    # dead-lettering into the output directory, closed (bounded) at the
+    # tail.  Both are None-gated: off is the pre-PR code path and the
+    # output directory is byte-identical.
+    if lineage is True:
+        lineage = LineageRecorder(fingerprint=fingerprint,
+                                  source="search_by_chunks")
+    elif not lineage:
+        lineage = None          # accept False/0/"" as "off" (CLI flag)
+    push_owned = False
+    if not push:
+        push = None
+    elif not isinstance(push, AlertBroker):
+        push = AlertBroker(
+            push, health=health,
+            dead_letter_path=os.path.join(
+                output_dir, f"push_dead_letter_{fingerprint}.jsonl"))
+        push_owned = True
 
     hits = []
     nproc = 0
@@ -837,7 +882,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     if http_port is not None:
         obs_server = start_obs_server(http_port, health=health,
                                       progress_fn=_progress_snapshot,
-                                      host=http_host)
+                                      host=http_host, push=push)
 
     # health consumes per-chunk DELTAS of process-wide counters (other
     # runs in this process may have bumped them already).  OOM events
@@ -898,6 +943,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         sigproc seam to contain that class.
         """
         t0 = time.perf_counter()
+        if lineage is not None:
+            lineage.mark(s, "read")
         try:
             nread = min(plan.step, nsamples - s)
             block = None
@@ -1055,11 +1102,37 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                         {"error": repr(exc)})
                         reason = "persist_dead_letter"
         store.mark_done(istart_, reason=reason)
+        return reason
 
-    def _persist_async(payload, istart_, iend_, pspan=None, reason=None):
+    def _lineage_finish(cl, istart_, iend_, payload, reason_out):
+        """Stamp persist-complete on a hit's lineage and write its doc
+        beside the npz pair (ISSUE 18).  A dead-lettered persist has no
+        artifact to sit beside — the candidate span still ends so the
+        trace never shows an unterminated bar."""
+        if cl is None:
+            return
+        if payload is not None and reason_out is None:
+            try:
+                lineage.persisted(
+                    cl, writer=lambda doc, a=istart_, b=iend_:
+                    store.save_lineage(root, a, b, doc))
+            except OSError as exc:
+                # the doc is observability riding beside the candidate:
+                # a full disk here must not fail a persisted hit
+                logger.warning("lineage doc for chunk %d-%d failed "
+                               "(%r); candidate unaffected",
+                               istart_, iend_, exc)
+                cl.span.end()
+        else:
+            cl.span.end()
+
+    def _persist_async(payload, istart_, iend_, pspan=None, reason=None,
+                       cl=None):
         t0 = time.perf_counter()
         try:
-            _persist_and_mark(payload, istart_, iend_, reason=reason)
+            out = _persist_and_mark(payload, istart_, iend_,
+                                    reason=reason)
+            _lineage_finish(cl, istart_, iend_, payload, out)
         finally:
             timer.add_async("persist", time.perf_counter() - t0)
             if pspan is not None:
@@ -1233,6 +1306,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # blocking search (see prefetch_upload)
             array_dev = prefetch_upload(next_read)
 
+            if lineage is not None:
+                lineage.mark(istart, "dispatch")
             try:
                 with with_timer("search"):
                     result = _search_with_fallback(
@@ -1264,11 +1339,17 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 nproc += 1
                 if canary is not None:
                     canary.discard(istart)
+                if lineage is not None:
+                    lineage.discard(istart)
                 _health_update(istart,
                                wall_s=time.perf_counter() - t_chunk,
                                quarantined=True, oom_floor=True)
                 continue
             table, plane = result if capture else (result, None)
+            if lineage is not None:
+                # device ready/readback: the search result is host-
+                # visible from here on
+                lineage.mark(istart, "ready")
             if plane_consumer is not None and plane is not None:
                 # the periodicity accumulation seam: the consumer sees
                 # the plane (device array or ShardedPlane handle)
@@ -1420,6 +1501,7 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                                 info.period_freq, info.period_dm,
                                 info.period_sigma)
 
+            cl = None
             if is_hit:
                 info.dm = float(best["DM"])
                 info.snr = float(best["snr"])
@@ -1463,6 +1545,29 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 obs_metrics.counter("putpu_hits_total").inc()
                 logger.info("HIT chunk %d-%d: DM=%.2f snr=%.2f width=%gs",
                             istart, iend, info.dm, info.snr, info.width)
+                if lineage is not None:
+                    # sift verdict: freeze the chunk's stage marks into
+                    # this candidate's lineage doc + open its span
+                    cl = lineage.candidate(
+                        istart, iend, name=f"{root}_{istart}-{iend}",
+                        dm=info.dm, snr=info.snr, width=info.width)
+                if push is not None:
+                    # fan-out at the hit-append site: canary best rows
+                    # were tagged/promoted above, so the broker only
+                    # ever sees genuine science candidates.  Enqueue-
+                    # only — a wedged subscriber cannot touch the loop.
+                    push.publish(
+                        {"schema_version": 1, "kind": "candidate",
+                         "fname": os.path.basename(str(fname)),
+                         "root": root, "chunk": int(istart),
+                         "iend": int(iend), "t_start_s": float(t0),
+                         "dm": info.dm, "snr": info.snr,
+                         "width_s": info.width,
+                         "fingerprint": fingerprint},
+                        on_delivered=(
+                            None if cl is None else
+                            lambda sub, _lat, _cl=cl:
+                            lineage.delivered(_cl, sub)))
 
             if make_plots == "all" or (make_plots == "hits" and is_hit):
                 from .diagnostics import plot_diagnostics
@@ -1492,7 +1597,8 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 pspan = begin_span("persist", track="persist-worker",
                                    chunk=istart)
                 persist_futures.append(persist_pool.submit(
-                    _persist_async, payload, istart, iend, pspan))
+                    _persist_async, payload, istart, iend, pspan,
+                    cl=cl))
                 # backpressure: each queued payload retains its cutout +
                 # table on the host, so an unbounded backlog on a
                 # hit-dense stream would grow without limit (the serial
@@ -1503,7 +1609,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                         persist_futures.pop(0).result()
             else:
                 with with_timer("persist"):
-                    _persist_and_mark(payload, istart, iend)
+                    reason_out = _persist_and_mark(payload, istart, iend)
+                    _lineage_finish(cl, istart, iend, payload,
+                                    reason_out)
             # second prefetch window: by the end of the iteration the
             # reader has had the whole search/persist to finish decoding
             # chunk k+1, so this attempt usually fires even when the
@@ -1524,6 +1632,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             _health_update(istart, wall_s=time.perf_counter() - t_chunk,
                            candidates=ncand_above,
                            headroom_frac=headroom_frac)
+            if lineage is not None:
+                # any candidate froze its marks at the sift verdict;
+                # dropping them here bounds the recorder's memory
+                lineage.discard(istart)
             if progress and nproc % 50 == 0:
                 logger.info("processed %d chunks (through sample %d/%d)",
                             nproc, iend, nsamples)
@@ -1542,6 +1654,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         with timer.bucket("persist_drain"):
             persist_pool.shutdown(wait=True)
             _drain_persist(block=True)
+    if push is not None and push_owned:
+        # bounded drain: a wedged subscriber journals to the dead
+        # letter and cannot stall the driver's exit.  PUSH_JSON is the
+        # one-line machine-readable delivery ledger, BUDGET_JSON-style.
+        logger.info("PUSH_JSON %s", json.dumps(push.close()))
     if health is not None and nproc:
         # tail flush: a persist dead-letter from the final drain (the
         # last chunk's write overlaps nothing) would otherwise never
@@ -1630,7 +1747,10 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                 health=health.snapshot() if health is not None else None,
                 canary=canary.to_json() if canary is not None else None,
                 quarantine=manifest.records(),
-                metrics=obs_metrics.REGISTRY.snapshot())
+                metrics=obs_metrics.REGISTRY.snapshot(),
+                lineage=(lineage.summary()
+                         if lineage is not None else None),
+                push=push.stats() if push is not None else None)
         except Exception as exc:
             logger.warning("survey report failed (%r); run result is "
                            "unaffected", exc)

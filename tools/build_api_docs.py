@@ -58,6 +58,8 @@ MODULES = [
     "pulsarutils_tpu.fleet.coordinator",
     "pulsarutils_tpu.fleet.worker",
     "pulsarutils_tpu.fleet.journal",
+    "pulsarutils_tpu.obs.lineage",
+    "pulsarutils_tpu.obs.push",
     "pulsarutils_tpu.io.atomic",
     "pulsarutils_tpu.resilience.memory_budget",
     "pulsarutils_tpu.resilience.ladder",

@@ -28,7 +28,12 @@ asserts the contracts ``docs/robustness.md`` documents:
   through a steal has its late artifact writes fenced and its
   completion stale-rejected, audit clean) and ``torn_journal`` (torn
   tail truncated to a ``.corrupt`` backup) all finish byte-identical
-  to the baseline.
+  to the baseline;
+* the **alert fan-out** (ISSUE 18) is wedge-proof: ``dead_subscriber``
+  runs the survey with push armed at a webhook that accepts but never
+  answers — every delivery dead-letters, the bounded queue
+  drops-oldest, health flags ``push`` DEGRADED then resolves at close,
+  and the survey outputs stay byte-identical.
 
 Wired as ``bench_suite.py`` config 9 so the drill result lands next to
 the perf-gate artifacts; the same matrix runs as a ``slow``+``chaos``
@@ -367,6 +372,15 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
         log(f"chaos drill: class {name}: "
             f"{'PASS' if classes[name]['ok'] else 'FAIL ' + str(classes[name])}")
 
+    # wedged alert subscriber (ISSUE 18): candidate push fan-out under a
+    # dead endpoint — the driver must never stall, outputs stay
+    # byte-identical, and the drops land in the dead-letter journal
+    log("chaos drill: class dead_subscriber (recoverable)")
+    classes["dead_subscriber"] = run_dead_subscriber_class(
+        base_dir, path, baseline, fingerprint, log)
+    log(f"chaos drill: class dead_subscriber: "
+        f"{'PASS' if classes['dead_subscriber']['ok'] else 'FAIL ' + str(classes['dead_subscriber'])}")
+
     recovered = sum(1 for r in classes.values()
                     if r["recoverable"] and r["ok"])
     contained = sum(1 for r in classes.values()
@@ -589,6 +603,79 @@ def run_torn_journal_class(base_dir, path, baseline, fingerprint,
             "byte_identical": not diffs, "diffs": diffs,
             "wall_s": round(time.time() - t0, 2),
             "ok": done and not diffs and backup_kept}
+
+
+# ---------------------------------------------------------------------------
+# alert fan-out chaos class (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def run_dead_subscriber_class(base_dir, path, baseline, fingerprint,
+                              log=print):
+    """**dead_subscriber**: an armed push subscriber accepts the TCP
+    connection but never answers.  Every delivery times out onto the
+    dead-letter journal, the 1-slot broker queue drops-oldest when
+    detections keep arriving, the health engine flags ``push`` DEGRADED
+    and resolves it at close — and the survey's durable outputs stay
+    byte-identical to the fault-free baseline: a wedged alert endpoint
+    can never stall or perturb the search itself."""
+    import http.server
+    import threading
+
+    from pulsarutils_tpu.obs.health import HealthEngine
+    from pulsarutils_tpu.obs.push import AlertBroker
+
+    outdir = os.path.join(base_dir, "dead_subscriber")
+    os.makedirs(outdir, exist_ok=True)
+
+    class _Hung(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            time.sleep(5.0)     # outlives every client timeout below
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Hung)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_port}/hook"
+    engine = HealthEngine()
+    dead_letter = os.path.join(outdir, "push_dead_letter.jsonl")
+    broker = AlertBroker([url], queue_max=1, timeout_s=0.5, retries=0,
+                         dead_letter_path=dead_letter, health=engine)
+    t0 = time.time()
+    try:
+        hits_f, _ = run_search(path, outdir, health=engine, push=broker)
+        # three rapid publishes against a wedged worker (in-flight
+        # delivery blocks 0.5 s) guarantee the 1-slot queue overflows:
+        # drop-oldest must fire and land in the dead-letter journal
+        for i in range(3):
+            broker.publish({"kind": "candidate", "chunk": -1 - i,
+                            "snr": 99.0, "fingerprint": fingerprint})
+        stats = broker.close(timeout_s=3.0)
+    finally:
+        server.shutdown()
+        server.server_close()
+    wall = round(time.time() - t0, 2)
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    with open(dead_letter) as f:
+        reasons = {json.loads(line).get("reason")
+                   for line in f if line.strip()}
+    health = _health_record(engine)
+    rec = {"recoverable": True, "fired": 1, "hits": len(hits_f),
+           "wall_s": wall, "byte_identical": not diffs, "diffs": diffs,
+           "delivered": stats["delivered"], "dropped": stats["dropped"],
+           "dead_lettered": stats["dead_lettered"],
+           "dead_letter_reasons": sorted(str(r) for r in reasons),
+           "health": health,
+           "health_ok": (health["worst"] in ("DEGRADED", "CRITICAL")
+                         and health["final"] == "OK")}
+    rec["ok"] = (not diffs and stats["delivered"] == 0
+                 and stats["dropped"] >= 1
+                 and stats["dead_lettered"] >= len(hits_f)
+                 and "dropped_oldest" in reasons and rec["health_ok"])
+    return rec
 
 
 # ---------------------------------------------------------------------------

@@ -91,11 +91,16 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: precision-policy A/B — its value drops to 0.0 when the bf16-operand
 #: arm's best candidate diverges from the f32 arm in any discrete
 #: field or its dedispersed profile violates the strategy's documented
-#: error bound against a float64 oracle; all fourteen run in
-#: tier-1-scale time)
+#: error bound against a float64 oracle; 22: the candidate-lifecycle
+#: A/B — its value drops to 0.0 when arming lineage+push moves a
+#: candidate/ledger byte, any persisted hit is missing its lineage doc
+#: (or its stages are non-monotone), the webhook sink misses a
+#: detection, or the filtered-out control subscriber receives one; all
+#: fifteen run in tier-1-scale time)
 DEFAULT_BASELINE_FMT = os.path.join(REPO, "BENCH_GATE_{backend}.jsonl")
 DEFAULT_BASELINE = DEFAULT_BASELINE_FMT.format(backend="cpu")
-DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21)
+DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21,
+                   22)
 
 #: the committed tune-cache artifact the gate version-checks (the
 #: snapshot-schema rule of PR 5, applied to tuner measurements: a
@@ -159,10 +164,16 @@ DEFAULT_TUNE_ARTIFACT = os.path.join(REPO, "TUNE_cpu.json")
 #: gather sweep — two jittery walls whose gated signal is the forced
 #: 0.0 on a discrete-field divergence or an error-bound violation
 #: against the float64 oracle, so the wall-clock bound applies.
+#: Config 22 (ISSUE 18) is the lineage+push off/on wall quotient over
+#: one multi-hit survey — the same quotient-of-walls shape; the gated
+#: signal is the forced 0.0 (byte divergence, missing/non-monotone
+#: lineage docs, missed or filter-violating deliveries), so the
+#: wall-clock bound applies.
 #: Config 10 stays TIGHT: canary recall is deterministic, not jittery.
 DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75, 13: 0.75,
                           14: 0.75, 15: 0.75, 16: 0.75, 17: 0.75,
-                          18: 0.75, 19: 0.75, 20: 0.75, 21: 0.75}
+                          18: 0.75, 19: 0.75, 20: 0.75, 21: 0.75,
+                          22: 0.75}
 
 
 def run_suite(configs, preset, out_path):

@@ -15,9 +15,22 @@ offset vs the coordinator) corrects skew exactly as the live collector
 would.  Load the output at <https://ui.perfetto.dev> — one process
 group per input file, the applied correction recorded on each group's
 ``clock_sync`` span.
+
+Candidate lineage filters (ISSUE 18)::
+
+    python tools/trace_merge.py merged.json *.json --candidate 8192
+    python tools/trace_merge.py merged.json *.json --trace-id ab12cd34
+
+``--trace-id`` keeps only the spans stamped with that distributed
+trace id (plus process/thread metadata and each group's ``clock_sync``
+anchor, so the timeline still aligns); ``--candidate CHUNK`` finds the
+``candidate`` span(s) whose ``chunk`` attr matches and keeps every
+span sharing their trace id(s) — one candidate's life across the
+coordinator and worker process groups in a single filtered view.
 """
 
 import argparse
+import json
 import os
 import sys
 
@@ -25,6 +38,46 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from pulsarutils_tpu.obs.collector import merge_trace_files  # noqa: E402
+
+#: always kept by the filters: Perfetto metadata rows and the per-group
+#: clock anchor — a filtered trace must still load and align
+_KEEP_ALWAYS = ("clock_sync",)
+
+
+def _candidate_trace_ids(events, chunk):
+    """Trace ids of every ``candidate`` span recorded for ``chunk``."""
+    ids = set()
+    for ev in events:
+        if ev.get("name") != "candidate":
+            continue
+        args = ev.get("args") or {}
+        if args.get("chunk") == chunk and args.get("trace_id"):
+            ids.add(args["trace_id"])
+    return ids
+
+
+def _filter_events(events, trace_ids):
+    """Keep metadata, clock anchors and spans in ``trace_ids``.
+
+    Async ``e`` (end) events carry no args — they are kept when their
+    ``(cat, id, pid)`` matches a kept begin, or the filtered trace
+    would render every surviving async span as unterminated.
+    """
+    kept, open_async = [], set()
+    for ev in events:
+        if ev.get("ph") == "M" or ev.get("name") in _KEEP_ALWAYS:
+            kept.append(ev)
+            continue
+        args = ev.get("args") or {}
+        if args.get("trace_id") in trace_ids:
+            kept.append(ev)
+            if ev.get("ph") == "b":
+                open_async.add((ev.get("cat"), ev.get("id"),
+                                ev.get("pid")))
+        elif ev.get("ph") == "e" and (ev.get("cat"), ev.get("id"),
+                                      ev.get("pid")) in open_async:
+            kept.append(ev)
+    return kept
 
 
 def main(argv=None):
@@ -37,12 +90,45 @@ def main(argv=None):
                         help="per-process Tracer.export JSON files")
     parser.add_argument("--names", nargs="*", default=None,
                         help="process-group names (default: file stems)")
+    parser.add_argument("--trace-id", default=None, metavar="ID",
+                        help="keep only spans stamped with this "
+                             "distributed trace id (+ metadata and "
+                             "clock_sync anchors)")
+    parser.add_argument("--candidate", type=int, default=None,
+                        metavar="CHUNK",
+                        help="keep only the span(s) of the candidate "
+                             "detected at this chunk start index, "
+                             "across every process group (resolves the "
+                             "candidate span's trace id, then filters "
+                             "like --trace-id)")
     opts = parser.parse_args(argv)
     if opts.names and len(opts.names) != len(opts.traces):
         parser.error("--names must match the number of trace files")
     collector = merge_trace_files(opts.traces, names=opts.names)
-    n = collector.export(opts.output)
-    print(f"trace_merge: {n} spans from {len(opts.traces)} file(s) -> "
+    if opts.trace_id is None and opts.candidate is None:
+        n = collector.export(opts.output)
+        print(f"trace_merge: {n} spans from {len(opts.traces)} "
+              f"file(s) -> {opts.output}")
+        return 0
+    doc = collector.to_chrome()
+    events = doc["traceEvents"]
+    trace_ids = set()
+    if opts.trace_id is not None:
+        trace_ids.add(opts.trace_id)
+    if opts.candidate is not None:
+        found = _candidate_trace_ids(events, opts.candidate)
+        if not found and opts.trace_id is None:
+            print(f"trace_merge: no candidate span for chunk "
+                  f"{opts.candidate} in the merged trace",
+                  file=sys.stderr)
+            return 1
+        trace_ids |= found
+    doc["traceEvents"] = _filter_events(events, trace_ids)
+    with open(opts.output, "w") as f:
+        json.dump(doc, f)
+    n = sum(ev.get("ph") in ("X", "b") for ev in doc["traceEvents"])
+    print(f"trace_merge: {n} spans (filtered to trace id(s) "
+          f"{sorted(trace_ids)}) from {len(opts.traces)} file(s) -> "
           f"{opts.output}")
     return 0
 
