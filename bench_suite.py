@@ -1988,12 +1988,149 @@ def config22(quick):
           "on_wall_s": round(on_wall, 2)})
 
 
+def config23(quick):
+    """Live-ingest A/B (ISSUE 19): the same survey searched twice —
+
+    * **file arm** — ``stream_search`` over chunks sliced straight off
+      the disk block (the classic path);
+    * **feed arm** — the block packetized into the PUTP wire format,
+      streamed over a localhost TCP socket into
+      :class:`~pulsarutils_tpu.ingest.ChunkAssembler`, and searched
+      from the assembler's live chunk iterator while the feeder is
+      still sending.
+
+    ``value`` is the file/feed wall ratio (the frontend's measured
+    overhead; ~1.0 expected — socket transfer and assembly overlap the
+    search) — FORCED to 0.0, far past any tolerance, when any
+    per-chunk result table byte-diverges between the arms, the hit
+    lists differ, any packet arrives damaged, or the ingest ledger
+    ends with gap-filled/journaled/unaccounted samples: a lossless
+    local feed must be byte-identical to the disk search.
+    """
+    import tempfile
+    import threading
+
+    from pulsarutils_tpu.ingest import (ChunkAssembler, TCPSource,
+                                        feed_tcp)
+    from pulsarutils_tpu.io.packets import packetize_array
+    from pulsarutils_tpu.io.sigproc import (FilterbankReader,
+                                            write_simulated_filterbank)
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.parallel.stream import stream_search
+
+    tsamp, nchan = 0.0005, 64
+    step = 4096 if quick else 8192
+    nchunks = 4
+    nsamples = nchunks * step
+    search_args = (100.0, 200.0, 1200.0, 200.0, tsamp)
+    search_kw = dict(backend="jax", kernel="auto", snr_threshold=6.5)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.default_rng(230)
+        arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+        # one pulse per interior chunk: both arms must agree on a
+        # multi-hit list, not just on noise tables
+        for h in range(1, nchunks - 1):
+            arr[:, h * step + step // 2] += 4.0
+        arr = disperse_array(arr, 150.0, 1200., 200., tsamp)
+        header = {"bandwidth": 200., "fbottom": 1200., "nchans": nchan,
+                  "nsamples": nsamples, "tsamp": tsamp,
+                  "foff": 200. / nchan}
+        fname = os.path.join(tmp, "survey.fil")
+        write_simulated_filterbank(fname, arr, header, descending=True)
+
+        reader = FilterbankReader(fname)
+        # the disk arm reads search-ready ascending chunks; the feed
+        # arm ships raw file-order frames and relies on the assembler
+        # to deliver the same ascending orientation
+        wire = reader.read_block(0, nsamples).astype(np.float32)
+        block = reader.read_block(
+            0, nsamples, band_ascending=True).astype(np.float32)
+        file_chunks = [(s, np.ascontiguousarray(block[:, s:s + step]))
+                       for s in range(0, nsamples, step)]
+
+        # warm the jit cache off the clock: both timed arms then run
+        # against the same compiled executable
+        stream_search(file_chunks, *search_args, **search_kw)
+
+        t0 = time.time()
+        res_file, hits_file = stream_search(file_chunks, *search_args,
+                                            **search_kw)
+        file_wall = time.time() - t0
+
+        encoded = packetize_array(
+            wire, samples_per_packet=256,
+            band_descending=reader.band_descending)
+        asm = ChunkAssembler(nchan=nchan, step=step,
+                             band_descending=reader.band_descending,
+                             policy="sanitize", shed=nchunks + 1,
+                             wait_poll_s=0.05)
+        t0 = time.time()
+        # max_reconnects=0: the reader drains the single feed
+        # connection, then exits + flushes the moment it closes — a
+        # deterministic end-of-feed, no idle-timeout wait on the clock
+        with TCPSource(asm, port=0, max_reconnects=0) as src:
+            feeder = threading.Thread(
+                target=feed_tcp, args=(src.host, src.port, encoded),
+                daemon=True)
+            feeder.start()
+            res_feed, hits_feed = stream_search(asm.chunks(),
+                                                *search_args,
+                                                **search_kw)
+            feeder.join(timeout=60)
+            src.wait(timeout_s=60)
+        feed_wall = time.time() - t0
+
+    identical = len(res_file) == len(res_feed)
+    if not identical:
+        log(f"config 23: chunk counts differ: {len(res_file)} file "
+            f"vs {len(res_feed)} feed")
+    for (sa, ta), (sb, tb) in zip(res_file, res_feed):
+        if sa != sb:
+            identical = False
+            log(f"config 23: chunk starts differ: {sa} vs {sb}")
+            continue
+        for col in ta.colnames:
+            if np.asarray(ta[col]).tobytes() \
+                    != np.asarray(tb[col]).tobytes():
+                identical = False
+                log(f"config 23: chunk {sa} column {col!r} bytes "
+                    "differ between arms")
+    hits_ok = ([h[0] for h in hits_file] == [h[0] for h in hits_feed]
+               and len(hits_file) >= nchunks - 2)
+    if not hits_ok:
+        log(f"config 23: hits differ or too few: "
+            f"{[h[0] for h in hits_file]} file vs "
+            f"{[h[0] for h in hits_feed]} feed")
+    led = asm.ledger
+    ledger_ok = (led.unaccounted() == 0 and not led.journal
+                 and led.gap_filled == 0 and led.observed == nsamples
+                 and asm.invalid == 0 and asm.duplicates == 0)
+    if not ledger_ok:
+        log(f"config 23: ingest ledger not clean: "
+            f"{asm.summary()['ledger']}")
+
+    ok = identical and hits_ok and ledger_ok
+    emit({"config": 23, "metric": "live-ingest A/B: localhost TCP "
+          f"packet feed vs disk chunks, {nchan}x{nsamples} survey "
+          f"({nchunks} chunks, {len(hits_file)} hits)",
+          "value": round(file_wall / feed_wall, 4) if ok else 0.0,
+          "unit": "x (file/feed wall; 0 = byte divergence, damaged "
+                  "packets, or unaccounted samples)",
+          "identical": bool(identical),
+          "hits_ok": bool(hits_ok),
+          "ledger_clean": bool(ledger_ok),
+          "packets": asm.packets,
+          "file_wall_s": round(file_wall, 3),
+          "feed_wall_s": round(feed_wall, 3)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
                                  13, 14, 15, 16, 17, 18, 19, 20, 21,
-                                 22])
+                                 22, 23])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -2028,7 +2165,8 @@ def main(argv=None):
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
            15: config15, 16: config16, 17: config17, 18: config18,
-           19: config19, 20: config20, 21: config21, 22: config22}
+           19: config19, 20: config20, 21: config21, 22: config22,
+           23: config23}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

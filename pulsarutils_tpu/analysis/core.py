@@ -215,7 +215,7 @@ def _load_builtin_checkers():
         return
     _BUILTINS_LOADED = True
     from . import (dtypes, device_trip, exceptions, locks,  # noqa: F401
-                   name_drift, persistence, retrace, spans)
+                   name_drift, persistence, reason_drift, retrace, spans)
 
 
 # -- the run -----------------------------------------------------------------

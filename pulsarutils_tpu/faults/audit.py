@@ -33,13 +33,15 @@ import logging
 import os
 
 from ..obs import metrics as _metrics
+from . import reasons
 from .policy import QuarantineManifest
 
 logger = logging.getLogger("pulsarutils_tpu")
 
 #: dead-letter reason (the persist hardening writes it; the audit knows
-#: a dead-lettered chunk legitimately has no candidate pair)
-DEAD_LETTER_REASON = "persist_dead_letter"
+#: a dead-lettered chunk legitimately has no candidate pair).
+#: Re-exported from the single-source vocabulary (ISSUE 19).
+DEAD_LETTER_REASON = reasons.PERSIST_DEAD_LETTER
 
 
 def _candidate_pairs(directory):

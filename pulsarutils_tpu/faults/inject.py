@@ -35,6 +35,16 @@ site       seam                                                 kinds
            The optional ``msg`` selector restricts a spec to
            one message name (``register``/``lease``/
            ``complete``/``release``); ``None`` matches all
+``ingest`` the live-feed packet path (``ingest/source.py``       ``drop``, ``reorder``,
+           feeder, ISSUE 19) — feed chaos per packet, the       ``duplicate``, ``corrupt``,
+           ``chunks`` selector matching the packet ``seq``:     ``disconnect``, ``burst``
+           ``drop`` loses the packet, ``reorder`` swaps it
+           with its successor, ``duplicate`` sends it twice,
+           ``corrupt`` flips payload bytes (the CRC rejects
+           it downstream -> a gap), ``disconnect`` tears the
+           connection (the source must reconnect), ``burst``
+           switches the feeder to unpaced firehose (overruns
+           a slow search -> shedding)
 ========== ==================================================== ==========================
 
 ``kind="oom"`` (ISSUE 12) raises a *real* ``XlaRuntimeError``-shaped
@@ -102,6 +112,11 @@ _CORRUPT_KINDS = ("nan", "inf", "dead_channels", "zero_run", "saturate",
 
 #: partition-chaos kinds for the ``wire`` site (ISSUE 15)
 _WIRE_KINDS = ("drop", "delay", "duplicate")
+
+#: feed-chaos kinds for the ``ingest`` site (ISSUE 19); applied per
+#: packet in the feeder/send path — the chunk selector matches seq
+_INGEST_KINDS = ("drop", "reorder", "duplicate", "corrupt",
+                 "disconnect", "burst")
 
 
 def _resource_exhausted_exc(site, chunk):
@@ -225,6 +240,23 @@ class FaultPlan:
             if not self._claim(spec):
                 continue
             return spec.kind, spec.seconds
+        return None
+
+    def ingest_action(self, site, seq=None):
+        """First matching feed-chaos action for one packet:
+        ``(kind, seconds, frac)`` for the ``ingest`` kinds
+        (``drop``/``reorder``/``duplicate``/``corrupt``/``disconnect``/
+        ``burst``), or ``None``.  The spec's ``chunks`` selector
+        matches the packet ``seq`` — feed chaos is addressed per
+        packet, not per chunk."""
+        for spec in self.specs:
+            if spec.kind not in _INGEST_KINDS or spec.site != site:
+                continue
+            if not spec.matches(site, seq):
+                continue
+            if not self._claim(spec):
+                continue
+            return spec.kind, spec.seconds, spec.frac
         return None
 
     def truncated_length(self, site, chunk, n):
@@ -402,3 +434,10 @@ def wire_action(site, msg=None):
     if plan is None or _SUPPRESS:
         return None
     return plan.wire_action(site, msg=msg)
+
+
+def ingest_action(site, seq=None):
+    plan = _ACTIVE if _ACTIVE is not None or _ENV_CHECKED else active()
+    if plan is None or _SUPPRESS:
+        return None
+    return plan.ingest_action(site, seq=seq)

@@ -1,0 +1,79 @@
+"""Single source of truth for quarantine-manifest reason vocabulary.
+
+Every record the :class:`~.policy.QuarantineManifest` appends names a
+*reason*, and that reason is load-bearing three times over: the audit
+(:mod:`.audit`) joins manifests against the ledger by reason, operators
+grep post-mortems by reason, and the docs promise a failure-policy
+matrix keyed by reason.  Before ISSUE 19 the vocabulary lived as string
+literals scattered across the pipeline; this module is the one place a
+reason may be *defined*, and the ``quarantine-reason`` putpu-lint
+checker (:mod:`..analysis.reason_drift`) keeps three parties in sync
+both ways:
+
+* code — a string literal passed to ``manifest.record(...)`` must be a
+  vocabulary member (or carry the ``integrity:`` composite prefix);
+* docs — every row of the marked reason table in ``docs/robustness.md``
+  must name a vocabulary member, and every vocabulary member must have
+  a row;
+* this module — a reason nobody records and nobody documents is flagged
+  as dead vocabulary.
+
+Stdlib-only and import-light on purpose: the lint checker AST-parses
+this file without importing the package, and the ingest frontend
+imports it on its socket-reader hot path.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "READ_ERROR", "SHORT_READ", "INTEGRITY_PREFIX", "PERSIST_DEAD_LETTER",
+    "OOM_FLOOR", "FEED_GAP", "SHED_OVERRUN", "QUARANTINE_REASONS",
+    "is_known_reason",
+]
+
+#: the chunk could not be read from its source at all (I/O error)
+READ_ERROR = "read_error"
+
+#: the source returned fewer samples than the chunk geometry promised
+SHORT_READ = "short_read"
+
+#: composite prefix: the integrity gate condemned the chunk; the gate's
+#: specific reasons (``nan_frac``, ``dead_frac``, ...) follow the colon
+INTEGRITY_PREFIX = "integrity:"
+
+#: candidate persist exhausted its retry budget; the manifest record IS
+#: the durable artifact (the candidate npz is missing on purpose)
+PERSIST_DEAD_LETTER = "persist_dead_letter"
+
+#: even the degradation ladder's numpy floor ran out of memory — this
+#: host cannot search chunks of this geometry
+OOM_FLOOR = "oom_floor"
+
+#: live-feed packet loss left the chunk's missing fraction above the
+#: integrity policy's zero rail: zero-filled samples would dominate
+FEED_GAP = "feed_gap"
+
+#: ingest outran search and the admission-control seam dropped this
+#: (oldest) assembled chunk whole — journaled, never silently lost
+SHED_OVERRUN = "shed_overrun"
+
+#: reason -> one-line meaning; THE vocabulary the lint checker enforces.
+#: ``integrity:`` is a prefix entry: recorded reasons append the gate's
+#: own condemnation list after the colon.
+QUARANTINE_REASONS = {
+    "read_error": "chunk unreadable from its source (I/O error)",
+    "short_read": "source returned fewer samples than the geometry",
+    "integrity:": "integrity gate condemned the chunk (composite prefix)",
+    "persist_dead_letter": "candidate persist exhausted its retries",
+    "oom_floor": "numpy ladder floor OOMed; geometry unsearchable here",
+    "feed_gap": "live-feed packet loss above the missing-fraction rail",
+    "shed_overrun": "ingest outran search; oldest chunk dropped whole",
+}
+
+
+def is_known_reason(reason):
+    """True when ``reason`` is vocabulary — exact member, or an
+    ``integrity:``-prefixed composite."""
+    reason = str(reason)
+    return reason in QUARANTINE_REASONS \
+        or reason.startswith(INTEGRITY_PREFIX)

@@ -1,0 +1,38 @@
+"""Live ingest frontend: sockets -> packets -> search chunks (ISSUE 19).
+
+Every driver before this PR started from a SIGPROC file; real-time
+dedispersion searches the stream as it arrives.  This package is the
+loss-tolerant frontend between a packetized feed and
+:func:`~pulsarutils_tpu.parallel.stream.stream_search`:
+
+* :mod:`~pulsarutils_tpu.io.packets` (in ``io/``) — the versioned wire
+  format; low-bit payloads land on the PR 10 ``PackedFrames``
+  device-unpack path so ingest bandwidth is bytes, not floats;
+* :mod:`.source` — UDP/TCP sources with bounded reconnect/backoff and
+  clean drain, plus the local feeders the bench/chaos/CLI sides use;
+* :mod:`.assembler` — the lock-disciplined ring buffer: bounded
+  reordering, zero-filled gaps accounted through the integrity gate
+  (``feed_gap``), drop-oldest load shedding through the
+  admission-control seam (``shed_overrun``), and the
+  :class:`~.assembler.IngestLedger` whose "zero unaccounted samples"
+  invariant the chaos drill pins.
+
+Quickstart (see ``docs/ingest.md``)::
+
+    asm = ChunkAssembler(nchan=64, step=8192)
+    with TCPSource(asm, port=9000):
+        results, hits = stream_search(asm.chunks(), ...)
+"""
+
+from .assembler import ChunkAssembler, IngestLedger  # noqa: F401
+from .source import (  # noqa: F401
+    TCPSource,
+    UDPSource,
+    feed_file,
+    feed_packets,
+    feed_tcp,
+    feed_udp,
+)
+
+__all__ = ["ChunkAssembler", "IngestLedger", "TCPSource", "UDPSource",
+           "feed_packets", "feed_tcp", "feed_udp", "feed_file"]

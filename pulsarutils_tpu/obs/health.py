@@ -20,6 +20,10 @@ EWMA baselines with hysteresis into a single ``OK`` / ``DEGRADED`` /
   — the robustness layer's counters become conditions, not just log
   lines; a permanent numpy fallback is a sticky condition (the run
   *works* but at reference speed — an operator must know);
+* **live-feed conditions** (ISSUE 19) — the ingest assembler reports
+  per-chunk gap fraction, shed overruns and source disconnects;
+  ``feed_gap``/``feed_disconnect`` degrade, sustained ``feed_overrun``
+  escalates to CRITICAL (search persistently behind the feed);
 * **canary recall floor** — the one science-facing rule: once enough
   canaries have been injected (:mod:`.canary`), a windowed recall below
   the floor is CRITICAL even when every perf counter is green — this is
@@ -83,7 +87,8 @@ class HealthEngine:
                  headroom_degraded=0.10, headroom_critical=0.03,
                  retrace_budget=3, retry_budget=3, quarantine_critical=3,
                  recall_floor=0.7, recall_min_injected=10,
-                 recall_window=20, recover_after=2, max_incidents=200):
+                 recall_window=20, recover_after=2, max_incidents=200,
+                 gap_degraded=0.0, overrun_critical_after=3):
         self.wall_factor = float(wall_factor)
         self.ewma_alpha = float(ewma_alpha)
         self.warmup = int(warmup)
@@ -99,6 +104,8 @@ class HealthEngine:
         self.recall_min_injected = int(recall_min_injected)
         self.recall_window = int(recall_window)
         self.recover_after = int(recover_after)
+        self.gap_degraded = float(gap_degraded)
+        self.overrun_critical_after = int(overrun_critical_after)
 
         self._lock = threading.Lock()
         self._active = {}           # kind -> _Condition
@@ -113,6 +120,7 @@ class HealthEngine:
         self._retries = 0
         self._quarantined = 0
         self._oom_events = 0
+        self._overrun_run = 0
 
     # -- condition plumbing --------------------------------------------------
 
@@ -165,7 +173,9 @@ class HealthEngine:
     def update(self, chunk, *, wall_s=None, candidates=None,
                quarantined=False, dead_letter=False, retraces=0,
                dispatch_retries=0, headroom_frac=None, fallback=False,
-               canary=None, oom_events=0, oom_floor=False):
+               canary=None, oom_events=0, oom_floor=False,
+               ingest_gap_frac=None, ingest_overrun=0,
+               ingest_disconnects=0):
         """Fold one chunk's telemetry in; returns the verdict after it.
 
         ``candidates`` is the number of table rows above the hit
@@ -179,6 +189,19 @@ class HealthEngine:
         even the ladder's numpy floor OOMed (-> ``oom_floor``
         CRITICAL); both decay on clean chunks like every non-sticky
         condition, so the verdict recovers once pressure lifts.
+
+        The ``ingest_*`` trio comes from the live-feed assembler
+        (ISSUE 19), once per cut chunk: ``ingest_gap_frac`` above
+        ``gap_degraded`` raises ``feed_gap`` DEGRADED (a lossy feed is
+        degraded science even when every chunk clears the quarantine
+        rail); ``ingest_overrun`` (chunks shed since the last cut)
+        raises ``feed_overrun`` DEGRADED, escalating to CRITICAL after
+        ``overrun_critical_after`` consecutive overrun chunks (search
+        is persistently behind the feed — data loss is structural, not
+        a blip); ``ingest_disconnects`` raises ``feed_disconnect``
+        DEGRADED.  All three decay over ``recover_after`` clean chunks
+        like every non-sticky condition: disconnect -> reconnect ->
+        OK once the feed holds.
         """
         with self._lock:
             self._updates += 1
@@ -272,6 +295,27 @@ class HealthEngine:
                      "even the numpy reference path ran out of memory "
                      "— this host cannot search chunks of this "
                      "geometry at all")
+
+            if ingest_gap_frac is not None \
+                    and float(ingest_gap_frac) > self.gap_degraded:
+                flag("feed_gap", DEGRADED,
+                     f"{100 * float(ingest_gap_frac):.2f}% of chunk "
+                     f"{chunk}'s samples never arrived (zero-filled)")
+            if ingest_overrun:
+                self._overrun_run += 1
+                sev = (CRITICAL
+                       if self._overrun_run >= self.overrun_critical_after
+                       else DEGRADED)
+                flag("feed_overrun", sev,
+                     f"{int(ingest_overrun)} chunk(s) shed at chunk "
+                     f"{chunk} — search is behind the feed "
+                     f"({self._overrun_run} consecutive)")
+            else:
+                self._overrun_run = 0
+            if ingest_disconnects:
+                flag("feed_disconnect", DEGRADED,
+                     f"{int(ingest_disconnects)} feed disconnect(s) "
+                     f"before chunk {chunk} (reconnected)")
 
             if headroom_frac is not None:
                 headroom_frac = float(headroom_frac)

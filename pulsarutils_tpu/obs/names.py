@@ -178,6 +178,34 @@ METRIC_NAMES = {
         "current verdict as rank (0 OK / 1 DEGRADED / 2 CRITICAL)",
     "putpu_hits_total":
         "chunks whose best S/N cleared the threshold",
+    "putpu_ingest_bytes_total":
+        "payload bytes accepted from the live feed (wire bandwidth — "
+        "bytes, not floats, on the packed path)",
+    "putpu_ingest_chunks_quarantined_total":
+        "assembled chunks quarantined as feed_gap (missing fraction "
+        "above the integrity policy's zero rail)",
+    "putpu_ingest_chunks_shed_total":
+        "assembled chunks dropped oldest-first because search fell "
+        "behind the feed (journaled shed_overrun)",
+    "putpu_ingest_chunks_total":
+        "fixed-geometry chunks cut by the ingest assembler",
+    "putpu_ingest_gap_samples_total":
+        "samples zero-filled because their packets never arrived",
+    "putpu_ingest_packets_duplicate_total":
+        "packets whose samples were already present (duplicates and "
+        "fully-late arrivals)",
+    "putpu_ingest_packets_invalid_total":
+        "packets rejected before assembly (bad header, CRC, geometry "
+        "mismatch)",
+    "putpu_ingest_packets_reordered_total":
+        "packets that arrived behind the stream watermark (reordered "
+        "within the assembly window)",
+    "putpu_ingest_packets_total":
+        "wire packets received by the ingest assembler",
+    "putpu_ingest_reconnects_total":
+        "feed connections re-accepted after a disconnect",
+    "putpu_ingest_shed_samples_total":
+        "samples in shed chunks (every one journaled shed_overrun)",
     "putpu_job_chunks_done_total":
         "chunks completed per service job (labelled by job id)",
     "putpu_job_hits_total":

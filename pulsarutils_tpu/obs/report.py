@@ -46,7 +46,7 @@ def _fmt(v, nd=3):
 def build_report(*, meta=None, budget=None, roofline=None, health=None,
                  canary=None, quarantine=None, sift=None, metrics=None,
                  coincidence=None, fleet=None, periodicity=None,
-                 slo=None, lineage=None, push=None):
+                 slo=None, lineage=None, push=None, ingest=None):
     """Assemble the structured report record (JSON-ready).
 
     ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
@@ -66,7 +66,8 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
     (ISSUE 14); ``lineage``: ``LineageRecorder.summary()`` — the
     "Candidate latency" per-stage waterfall (ISSUE 18); ``push``:
     ``AlertBroker.stats()`` — the "Alert push" delivery table
-    (ISSUE 18).
+    (ISSUE 18); ``ingest``: ``ChunkAssembler.summary()`` — the
+    "Ingest" feed/loss/shed accounting section (ISSUE 19).
     """
     rec = {
         "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -83,6 +84,7 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
         "slo": slo,
         "lineage": lineage,
         "push": push,
+        "ingest": ingest,
     }
     if metrics:
         totals = {}
@@ -360,6 +362,32 @@ def render_markdown(rec):
     else:
         lines += ["Alert push was off: no webhook fan-out this run.",
                   ""]
+
+    lines.append("## Ingest")
+    lines.append("")
+    ingest = rec.get("ingest")
+    if ingest:
+        led = ingest.get("ledger", {})
+        lines.append(
+            f"{ingest.get('packets', 0)} packet(s) received "
+            f"({ingest.get('invalid_packets', 0)} invalid, "
+            f"{ingest.get('duplicate_packets', 0)} duplicate, "
+            f"{ingest.get('reordered_packets', 0)} reordered); "
+            f"{ingest.get('reconnects', 0)} reconnect(s).")
+        lines.append("")
+        lines.append(_md_table(
+            ("samples", "count"),
+            [(k, led.get(k, 0))
+             for k in ("observed", "arrived", "gap_filled", "delivered",
+                       "shed", "quarantined", "unaccounted")]))
+        lines.append("")
+        if led.get("unaccounted", 0):
+            lines.append("**WARNING:** unaccounted samples — the feed "
+                         "session did not drain cleanly.")
+            lines.append("")
+    else:
+        lines += ["No live-feed frontend: this run searched from "
+                  "disk.", ""]
 
     lines.append("## Cross-beam coincidence")
     lines.append("")

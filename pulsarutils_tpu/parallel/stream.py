@@ -98,6 +98,30 @@ def iter_chunk_starts(nsamples, plan, tmin=0, sample_time=None):
         yield istart
 
 
+def _iter_lookahead(chunks):
+    """Pull-lazy iteration with exactly ONE chunk of lookahead.
+
+    ``stream_search`` must consume its producer as a true iterator
+    (ISSUE 19: a live feed cannot hold an observation in RAM), but a
+    strict lock-step pull would serialize chunk production behind the
+    device search.  Pre-pulling a single item keeps the classic
+    double-buffer overlap — the producer builds chunk ``k+1`` while
+    chunk ``k`` computes — with bounded memory by construction: at most
+    two produced-but-unconsumed chunks exist at any moment (the pending
+    slot plus the producer's in-flight ``next``).  A list producer
+    degrades gracefully (iteration order and results are identical).
+    """
+    it = iter(chunks)
+    try:
+        pending = next(it)
+    except StopIteration:
+        return
+    for item in it:
+        yield pending
+        pending = item
+    yield pending
+
+
 def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   *, backend="jax", snr_threshold=6.0, trial_dms=None,
                   dm_block=None, chan_block=None, budget=None, mesh=None,
@@ -106,6 +130,14 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                   http_host="127.0.0.1", canary=None,
                   plane_consumer=None, lineage=None, push=None):
     """Search an iterable of ``(istart, (nchan, step))`` chunks.
+
+    ``chunks`` is consumed as a true lazy iterator with one chunk of
+    lookahead (ISSUE 19): a generator producer — a file reader or the
+    live-ingest assembler — is pulled at most one chunk ahead of the
+    chunk being searched, so memory stays bounded by two chunks no
+    matter how long the observation runs, while production still
+    overlaps compute.  Lists keep working unchanged (and still
+    provide the progress total via ``len``).
 
     One compiled executable serves every distinct chunk shape; interior
     chunks share one shape by construction, so at most one extra compile
@@ -385,7 +417,7 @@ def stream_search(chunks, dmmin, dmmax, start_freq, bandwidth, sample_time,
                               lineage.delivered(_cl, sub)))
 
     try:
-      for istart, chunk in chunks:
+      for istart, chunk in _iter_lookahead(chunks):
         # with a budget, the chunk/search spans come from the accountant
         # itself (one timing primitive); without one, emit them directly
         # so a trace-only stream still renders per-chunk tracks

@@ -29,6 +29,7 @@ import zlib
 import numpy as np
 
 from ..faults import inject as fault_inject
+from ..faults import reasons as fault_reasons
 from ..faults.policy import (DispatchPolicy, QuarantineManifest,
                              call_with_deadline, gate_chunk,
                              gate_chunk_lowbit, gate_chunk_packed,
@@ -1098,9 +1099,9 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                             "(%r): dead-letter recorded, run continues",
                             istart_, iend_, attempt + 1, exc)
                         manifest.record(istart_, iend_,
-                                        "persist_dead_letter",
+                                        fault_reasons.PERSIST_DEAD_LETTER,
                                         {"error": repr(exc)})
-                        reason = "persist_dead_letter"
+                        reason = fault_reasons.PERSIST_DEAD_LETTER
         store.mark_done(istart_, reason=reason)
         return reason
 
@@ -1178,18 +1179,19 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
             # resume never retries it) and the stream moves on
             quarantine_reason = q_stats = None
             if isinstance(array, _ReadFailure):
-                quarantine_reason = "read_error"
+                quarantine_reason = fault_reasons.READ_ERROR
                 q_stats = {"error": repr(array.exc)}
             else:
                 got = array.shape[0] if packed_bits else array.shape[1]
                 if got < chunk_size:
-                    quarantine_reason = "short_read"
+                    quarantine_reason = fault_reasons.SHORT_READ
                     q_stats = {"expected": int(chunk_size),
                                "got": int(got)}
                 elif gate_info is not None:
                     if gate_info["verdict"] == "quarantine":
-                        quarantine_reason = "integrity:" + ",".join(
-                            gate_info["reasons"])
+                        quarantine_reason = \
+                            fault_reasons.INTEGRITY_PREFIX + ",".join(
+                                gate_info["reasons"])
                         q_stats = gate_info["stats"]
                     elif gate_info["verdict"] == "sanitized":
                         obs_metrics.counter(
@@ -1326,16 +1328,16 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
                     "putpu_chunks_quarantined_total").inc()
                 logger.error("chunk %d-%d QUARANTINED (oom_floor): %r "
                              "-> %s", istart, iend, exc, manifest.path)
-                manifest.record(istart, iend, "oom_floor",
+                manifest.record(istart, iend, fault_reasons.OOM_FLOOR,
                                 {"error": repr(exc)})
                 if persist_pool is not None:
                     persist_futures.append(persist_pool.submit(
                         _persist_async, None, istart, iend,
-                        reason="oom_floor"))
+                        reason=fault_reasons.OOM_FLOOR))
                 else:
                     with with_timer("persist"):
                         _persist_and_mark(None, istart, iend,
-                                          reason="oom_floor")
+                                          reason=fault_reasons.OOM_FLOOR)
                 nproc += 1
                 if canary is not None:
                     canary.discard(istart)

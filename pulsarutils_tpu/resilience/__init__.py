@@ -23,7 +23,11 @@ inference serving stack sheds batch size under memory pressure.
   unsplit dispatch (per-trial rows are independent sums in both
   formulations; gather output columns are independent), counted and
   surfaced as :class:`~pulsarutils_tpu.obs.health.HealthEngine`
-  conditions.
+  conditions;
+* :mod:`.shedding` — the live-ingest admission-control policy
+  (ISSUE 19): bound the assembler's ready-chunk queue by depth/bytes
+  and shed drop-oldest when search falls behind a live feed, so the
+  socket reader is never blocked by a wedged consumer.
 """
 
 from .ladder import (  # noqa: F401
@@ -31,6 +35,7 @@ from .ladder import (  # noqa: F401
     is_resource_exhausted,
 )
 from .memory_budget import estimate_direct, headroom_bytes  # noqa: F401
+from .shedding import ShedPolicy, resolve_shed_policy  # noqa: F401
 
 __all__ = ["OOMFloorError", "is_resource_exhausted", "estimate_direct",
-           "headroom_bytes"]
+           "headroom_bytes", "ShedPolicy", "resolve_shed_policy"]

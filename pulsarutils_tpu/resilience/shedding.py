@@ -1,0 +1,70 @@
+"""Admission control for the live-ingest ring buffer (ISSUE 19).
+
+The ingest assembler produces fixed-geometry chunks at the feed's pace;
+``stream_search`` consumes them at the device's pace.  When the feed is
+faster, *something* must give — and the one thing a real-time frontend
+may never do is block the socket reader (kernel buffers overflow and
+loss becomes silent).  :class:`ShedPolicy` bounds the ready-chunk queue
+the same way the PR 11 memory budget bounds a dispatch: by an explicit
+byte/depth budget decided *before* the overload, not under it.
+
+The policy only answers "how many assembled chunks may wait?"; the
+assembler enforces it with the PR 18 AlertBroker discipline one level
+down the stack — drop the **oldest** queued chunk whole (the freshest
+data is the most alert-relevant), journal the drop as a
+``shed_overrun`` quarantine record with exact sample accounting, and
+keep the reader lock-free of the consumer.  Nothing is ever silently
+lost: the ingest ledger's invariant (delivered + shed + quarantined ==
+observed) is checked by the chaos drill's ``overrun_feed`` class.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ShedPolicy", "resolve_shed_policy"]
+
+
+class ShedPolicy:
+    """Bound the assembler's ready queue by depth and/or host bytes.
+
+    ``max_chunks`` is the hard depth cap; ``max_bytes`` additionally
+    shrinks the allowed depth when chunks are large (``max_bytes //
+    chunk_nbytes``, floor 1 — a queue that can hold *no* chunk would
+    deadlock a healthy feed).  Either may be ``None`` (unbounded on
+    that axis); both ``None`` disables shedding entirely.
+    """
+
+    def __init__(self, max_chunks=8, max_bytes=None):
+        self.max_chunks = None if max_chunks is None else int(max_chunks)
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        if self.max_chunks is not None and self.max_chunks < 1:
+            raise ValueError("max_chunks must be >= 1 (or None)")
+
+    def max_queued(self, chunk_nbytes=None):
+        """Allowed ready-queue depth for chunks of ``chunk_nbytes``
+        host bytes; ``None`` means unbounded."""
+        depth = self.max_chunks
+        if self.max_bytes is not None and chunk_nbytes:
+            by_bytes = max(self.max_bytes // int(chunk_nbytes), 1)
+            depth = by_bytes if depth is None else min(depth, by_bytes)
+        return depth
+
+    def should_shed(self, queued, chunk_nbytes=None):
+        """True when admitting one more chunk over ``queued`` waiting
+        ones must first drop the oldest."""
+        depth = self.max_queued(chunk_nbytes)
+        return depth is not None and int(queued) >= depth
+
+    def to_json(self):
+        return {"max_chunks": self.max_chunks,
+                "max_bytes": self.max_bytes}
+
+
+def resolve_shed_policy(policy):
+    """Accept the CLI/driver spellings: an int is a depth cap, ``None``
+    /``"off"`` disables shedding, a :class:`ShedPolicy` passes
+    through."""
+    if policy is None or policy == "off":
+        return ShedPolicy(max_chunks=None, max_bytes=None)
+    if isinstance(policy, ShedPolicy):
+        return policy
+    return ShedPolicy(max_chunks=int(policy))

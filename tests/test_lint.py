@@ -926,3 +926,108 @@ def test_update_baseline_refuses_select(tmp_path):
                    str(pkg))
     assert res.returncode == 2
     assert "unselected" in res.stderr
+
+
+# -- checker: quarantine-reason vocabulary (ISSUE 19) -------------------------
+
+REASONS_FIXTURE = '''\
+FEED_GAP = "feed_gap"
+SHED_OVERRUN = "shed_overrun"
+QUARANTINE_REASONS = {
+    "feed_gap": "unrecoverable feed loss",
+    "shed_overrun": "drop-oldest load shedding",
+}
+'''
+
+REASON_DOC_FIXTURE = '''\
+# robustness
+
+<!-- quarantine-reasons:begin -->
+| `feed_gap` | quarantine | audit row |
+| `shed_overrun` | journal | audit row |
+<!-- quarantine-reasons:end -->
+'''
+
+
+def _reason_root(tmp_path, doc=REASON_DOC_FIXTURE):
+    faults = tmp_path / "pulsarutils_tpu" / "faults"
+    faults.mkdir(parents=True)
+    (faults / "reasons.py").write_text(REASONS_FIXTURE)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "robustness.md").write_text(doc)
+    return str(tmp_path)
+
+
+def test_reason_unknown_literal_fires(tmp_path):
+    project = LintProject(root=_reason_root(tmp_path))
+    project.check_source(
+        'def f(m):\n    m.record(0, 8, "mystery", {})\n',
+        "pulsarutils_tpu/faults/fixture.py")
+    assert ids(project.findings) == ["quarantine-reason-unknown"]
+
+
+def test_reason_vocabulary_literal_and_constant_are_silent(tmp_path):
+    project = LintProject(root=_reason_root(tmp_path))
+    project.check_source(
+        "from . import reasons\n"
+        "def f(m):\n"
+        '    m.record(0, 8, "feed_gap", {})\n'
+        "    m.record(0, 8, reasons.SHED_OVERRUN, {})\n",
+        "pulsarutils_tpu/faults/fixture.py")
+    assert project.findings == []
+    assert project.finalize() == []  # documented + not a full scan
+
+
+def test_reason_dynamic_fires_integrity_composite_sanctioned(tmp_path):
+    project = LintProject(root=_reason_root(tmp_path))
+    project.check_source(
+        "def f(m, x):\n"
+        '    m.record(0, 8, f"weird-{x}", {})\n'
+        '    m.record(0, 8, "integrity:" + x, {})\n',
+        "pulsarutils_tpu/faults/fixture.py")
+    assert ids(project.findings) == ["quarantine-reason-dynamic"]
+
+
+def test_reason_undocumented_vocab_member_fires(tmp_path):
+    doc = REASON_DOC_FIXTURE.replace(
+        "| `shed_overrun` | journal | audit row |\n", "")
+    project = LintProject(root=_reason_root(tmp_path, doc=doc))
+    project.check_source("x = 1\n", "pulsarutils_tpu/faults/fixture.py")
+    extra = project.finalize()
+    assert ids(extra) == ["quarantine-reason-undocumented"]
+    assert "shed_overrun" in extra[0].message
+
+
+def test_reason_doc_row_unknown_to_vocab_fires(tmp_path):
+    doc = REASON_DOC_FIXTURE.replace(
+        "<!-- quarantine-reasons:end -->",
+        "| `ghost_reason` | ? | ? |\n<!-- quarantine-reasons:end -->")
+    project = LintProject(root=_reason_root(tmp_path, doc=doc))
+    project.check_source("x = 1\n", "pulsarutils_tpu/faults/fixture.py")
+    extra = project.finalize()
+    assert ids(extra) == ["quarantine-reason-doc-unknown"]
+    assert "ghost_reason" in extra[0].message
+
+
+def test_reason_unused_arms_only_on_full_layer_scan(tmp_path):
+    root = _reason_root(tmp_path)
+    project = LintProject(root=root)
+    project.check_source(
+        'def f(m):\n    m.record(0, 8, "feed_gap", {})\n',
+        "pulsarutils_tpu/faults/fixture.py")
+    for layer in ("obs", "parallel", "pipeline", "io", "ingest"):
+        project.check_source("x = 1\n",
+                             f"pulsarutils_tpu/{layer}/fixture.py")
+    extra = project.finalize()
+    assert ids(extra) == ["quarantine-reason-unused"]
+    assert "shed_overrun" in extra[0].message
+    # the same sources WITHOUT the ingest layer: the sweep is partial,
+    # so the dead-vocabulary direction must stay quiet
+    partial = LintProject(root=root)
+    partial.check_source(
+        'def f(m):\n    m.record(0, 8, "feed_gap", {})\n',
+        "pulsarutils_tpu/faults/fixture.py")
+    for layer in ("obs", "parallel", "pipeline", "io"):
+        partial.check_source("x = 1\n",
+                             f"pulsarutils_tpu/{layer}/fixture.py")
+    assert partial.finalize() == []

@@ -66,12 +66,18 @@ MODULES = [
     "pulsarutils_tpu.io.sigproc",
     "pulsarutils_tpu.io.lowbit",
     "pulsarutils_tpu.io.candidates",
+    "pulsarutils_tpu.io.packets",
+    "pulsarutils_tpu.ingest.assembler",
+    "pulsarutils_tpu.ingest.source",
+    "pulsarutils_tpu.faults.reasons",
+    "pulsarutils_tpu.resilience.shedding",
     "pulsarutils_tpu.utils.table",
     "pulsarutils_tpu.utils.logging_utils",
     "pulsarutils_tpu.cli.stats_main",
     "pulsarutils_tpu.cli.search_main",
     "pulsarutils_tpu.cli.clean_main",
     "pulsarutils_tpu.cli.cands_main",
+    "pulsarutils_tpu.cli.ingest_main",
 ]
 
 

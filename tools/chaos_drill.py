@@ -33,7 +33,16 @@ asserts the contracts ``docs/robustness.md`` documents:
   runs the survey with push armed at a webhook that accepts but never
   answers — every delivery dead-letters, the bounded queue
   drops-oldest, health flags ``push`` DEGRADED then resolves at close,
-  and the survey outputs stay byte-identical.
+  and the survey outputs stay byte-identical;
+* the **live ingest frontend** (ISSUE 19) contains every feed-failure
+  mode: ``lossy_feed`` (drop/corrupt/reorder/duplicate — sub-threshold
+  loss sanitized byte-exactly, heavy loss quarantined as ``feed_gap``),
+  ``disconnected_feed`` (torn TCP connection re-established, all
+  chunks byte-identical to disk) and ``overrun_feed`` (wedged search:
+  the socket reader never blocks, oldest chunks shed as
+  ``shed_overrun``, sustained overrun reaches CRITICAL) — each class
+  ends with the quarantine manifest mirroring the ingest ledger's
+  journal exactly and **zero unaccounted samples**.
 
 Wired as ``bench_suite.py`` config 9 so the drill result lands next to
 the perf-gate artifacts; the same matrix runs as a ``slow``+``chaos``
@@ -381,6 +390,17 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
     log(f"chaos drill: class dead_subscriber: "
         f"{'PASS' if classes['dead_subscriber']['ok'] else 'FAIL ' + str(classes['dead_subscriber'])}")
 
+    # live ingest frontend (ISSUE 19): the feed-failure containment
+    # matrix — loss accounted, disconnects survived byte-identical,
+    # overrun shed bounded — each ending with zero unaccounted samples
+    for name, fn in (("lossy_feed", run_lossy_feed_class),
+                     ("disconnected_feed", run_disconnected_feed_class),
+                     ("overrun_feed", run_overrun_feed_class)):
+        log(f"chaos drill: class {name}")
+        classes[name] = fn(base_dir, path, baseline, fingerprint, log)
+        log(f"chaos drill: class {name}: "
+            f"{'PASS' if classes[name]['ok'] else 'FAIL ' + str(classes[name])}")
+
     recovered = sum(1 for r in classes.values()
                     if r["recoverable"] and r["ok"])
     contained = sum(1 for r in classes.values()
@@ -675,6 +695,252 @@ def run_dead_subscriber_class(base_dir, path, baseline, fingerprint,
                  and stats["dropped"] >= 1
                  and stats["dead_lettered"] >= len(hits_f)
                  and "dropped_oldest" in reasons and rec["health_ok"])
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# live ingest chaos classes (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+#: feed geometry: non-overlapping chunks (the assembler's contract),
+#: 256-sample packets -> 32 packets per 8192-sample chunk, 128 total
+INGEST_STEP = 8192
+INGEST_SPP = 256
+
+
+def _audit_feed(manifest_path, asm):
+    """The feed frontend's audit: every loss-bearing manifest record
+    mirrors a ledger journal entry (both directions, exact spans) and
+    the disposition axis balances.  Returns a list of issues (empty =
+    clean)."""
+    records = []
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+    man = sorted((int(r["chunk"]), int(r["end"]), r["reason"])
+                 for r in records)
+    led = sorted((int(r["chunk"]), int(r["end"]), r["reason"])
+                 for r in asm.ledger.journal)
+    issues = []
+    if man != led:
+        issues.append(f"manifest records != ledger journal: "
+                      f"{man} != {led}")
+    unaccounted = asm.ledger.unaccounted()
+    if unaccounted:
+        issues.append(f"{unaccounted} samples unaccounted for")
+    return issues
+
+
+def _feed_harness(outdir, path, plan=None, *, step=INGEST_STEP, shed=8,
+                  pace_s=0.0, consume_during_feed=True, recover_after=1):
+    """One feed session over the drill survey file: packetize, serve a
+    TCPSource + assembler, feed under ``plan``, drain.  Returns the
+    session record every feed class asserts against."""
+    import threading
+
+    from pulsarutils_tpu.faults.policy import QuarantineManifest
+    from pulsarutils_tpu.ingest import ChunkAssembler, TCPSource, feed_tcp
+    from pulsarutils_tpu.io.packets import packetize_array
+    from pulsarutils_tpu.io.sigproc import FilterbankReader
+    from pulsarutils_tpu.obs.health import HealthEngine
+
+    os.makedirs(outdir, exist_ok=True)
+    reader = FilterbankReader(path)
+    wire = reader.read_block(0, reader.nsamples).astype(np.float32)
+    encoded = packetize_array(wire, samples_per_packet=INGEST_SPP,
+                              band_descending=reader.band_descending)
+    # the assembler delivers search-ready ascending chunks whatever
+    # the wire order: expectations compare against the ascending view
+    block = (np.ascontiguousarray(wire[::-1])
+             if reader.band_descending else wire)
+    manifest = QuarantineManifest(outdir, "feed")
+    health = HealthEngine(recover_after=recover_after)
+    asm = ChunkAssembler(nchan=reader.nchans, step=step,
+                         band_descending=reader.band_descending,
+                         policy="sanitize", shed=shed,
+                         manifest=manifest, health=health,
+                         wait_poll_s=0.05)
+    delivered = {}
+
+    def consume():
+        for istart, chunk in asm.chunks():
+            delivered[istart] = np.asarray(chunk)
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    ctx = plan.armed() if plan is not None else contextlib.nullcontext()
+    with TCPSource(asm, port=0, idle_timeout_s=0.5) as src:
+        if consume_during_feed:
+            consumer.start()
+        t0 = time.time()
+        with ctx:
+            feed_tcp(src.host, src.port, encoded, pace_s=pace_s)
+        feed_wall = time.time() - t0
+        # the reader drains every byte already on the wire, goes idle,
+        # then flushes the assembler itself — close() after wait() is
+        # a no-op shutdown, not a data race
+        assert src.wait(timeout_s=60), "ingest reader failed to drain"
+    # the idle flush closed the assembler; a wedged-consumer class
+    # starts draining only now
+    if not consume_during_feed:
+        consumer.start()
+    consumer.join(timeout=60)
+    return {"asm": asm, "health": health, "delivered": delivered,
+            "block": block, "feed_wall_s": feed_wall,
+            "manifest_path": manifest.path}
+
+
+def _chunks_identical(delivered, block, starts, step):
+    """Byte-compare delivered chunks against the disk block."""
+    bad = []
+    for s in starts:
+        got = delivered.get(s)
+        want = np.ascontiguousarray(block[:, s:s + step])
+        if got is None or got.tobytes() != want.tobytes():
+            bad.append(s)
+    return bad
+
+
+def run_lossy_feed_class(base_dir, path, baseline, fingerprint,
+                         log=print):
+    """**lossy_feed**: the feed drops, corrupts, reorders and
+    duplicates packets.  Sub-threshold loss is sanitized (delivered
+    zero-filled, byte-exact against the disk block with the gaps
+    zeroed), unrecoverable loss quarantines the chunk as ``feed_gap``,
+    reorder/duplicate lose nothing — and the ledger accounts for every
+    observed sample with the manifest mirroring the journal exactly."""
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+
+    outdir = os.path.join(base_dir, "lossy_feed")
+    t0 = time.time()
+    # chunk 0 (seqs 0-31): one dropped + one CRC-corrupted packet ->
+    # 512/8192 samples gap-filled, sanitized.  chunk 1 (seqs 32-63):
+    # 28/32 packets dropped -> 87.5% loss > max_zero_frac 0.75 ->
+    # feed_gap quarantine.  chunk 2: swap + duplicate, lossless.
+    # chunk 3: untouched.
+    plan = FaultPlan([
+        FaultSpec(site="ingest", kind="drop", chunks=(5,), times=1),
+        FaultSpec(site="ingest", kind="corrupt", chunks=(20,), times=1),
+        FaultSpec(site="ingest", kind="drop",
+                  chunks=tuple(range(36, 64)), times=None),
+        FaultSpec(site="ingest", kind="reorder", chunks=(70,), times=1),
+        FaultSpec(site="ingest", kind="duplicate", chunks=(80,),
+                  times=1),
+    ])
+    sess = _feed_harness(outdir, path, plan)
+    asm, health, block = sess["asm"], sess["health"], sess["block"]
+    delivered = sess["delivered"]
+    led = asm.ledger
+
+    expected = block.copy()
+    for seq in (5, 20):                       # dropped + CRC-rejected
+        expected[:, seq * INGEST_SPP:(seq + 1) * INGEST_SPP] = 0.0
+    sanitized_bad = _chunks_identical(
+        delivered, expected, (0, 2 * INGEST_STEP, 3 * INGEST_STEP),
+        INGEST_STEP)
+    audit_issues = _audit_feed(sess["manifest_path"], asm)
+    hrec = _health_record(health)
+    rec = {"recoverable": False, "fired": plan.fired(),
+           "wall_s": round(time.time() - t0, 2),
+           "delivered_chunks": sorted(delivered),
+           "gap_filled": led.gap_filled,
+           "quarantined_samples": led.quarantined,
+           "journal_reasons": sorted({r["reason"]
+                                      for r in led.journal}),
+           "unaccounted": led.unaccounted(),
+           "audit_ok": not audit_issues, "audit_issues": audit_issues,
+           "diffs": [f"chunk {s} differs" for s in sanitized_bad],
+           "health": hrec,
+           "health_ok": (hrec["worst"] in ("DEGRADED", "CRITICAL")
+                         and hrec["final"] == "OK")}
+    rec["ok"] = (bool(plan.fired()) and not audit_issues
+                 and not sanitized_bad
+                 and INGEST_STEP not in delivered       # quarantined
+                 and led.quarantined == INGEST_STEP
+                 and rec["journal_reasons"] == ["feed_gap"]
+                 and led.unaccounted() == 0
+                 and asm.invalid >= 1 and rec["health_ok"])
+    return rec
+
+
+def run_disconnected_feed_class(base_dir, path, baseline, fingerprint,
+                                log=print):
+    """**disconnected_feed**: the feeder's TCP connection is torn
+    mid-stream and re-established.  Nothing is lost: every chunk is
+    byte-identical to the disk block, the reconnect is counted and
+    flagged (``feed_disconnect`` DEGRADED) and health recovers to OK
+    with clean chunks behind it."""
+    from pulsarutils_tpu.faults.inject import FaultPlan, FaultSpec
+
+    outdir = os.path.join(base_dir, "disconnected_feed")
+    t0 = time.time()
+    plan = FaultPlan([FaultSpec(site="ingest", kind="disconnect",
+                                chunks=(64,), times=1)])
+    sess = _feed_harness(outdir, path, plan)
+    asm, health = sess["asm"], sess["health"]
+    bad = _chunks_identical(
+        sess["delivered"], sess["block"],
+        range(0, NSAMPLES, INGEST_STEP), INGEST_STEP)
+    audit_issues = _audit_feed(sess["manifest_path"], asm)
+    hrec = _health_record(health)
+    rec = {"recoverable": True, "fired": plan.fired(),
+           "wall_s": round(time.time() - t0, 2),
+           "reconnects": asm.reconnects,
+           "byte_identical": not bad,
+           "diffs": [f"chunk {s} differs" for s in bad],
+           "unaccounted": asm.ledger.unaccounted(),
+           "audit_ok": not audit_issues, "audit_issues": audit_issues,
+           "health": hrec,
+           "health_ok": (hrec["worst"] == "DEGRADED"
+                         and hrec["final"] == "OK")}
+    rec["ok"] = (bool(plan.fired()) and not bad
+                 and asm.reconnects == 1
+                 and asm.ledger.unaccounted() == 0
+                 and not audit_issues and rec["health_ok"])
+    return rec
+
+
+def run_overrun_feed_class(base_dir, path, baseline, fingerprint,
+                           log=print):
+    """**overrun_feed**: the consumer is wedged while the feed bursts.
+    ``push()`` must stay bounded (the socket reader never blocks on
+    search), the 2-chunk admission bound drops the OLDEST queued
+    chunks journaled as ``shed_overrun``, sustained overrun reaches
+    CRITICAL, and after the wedge lifts the survivors are
+    byte-identical with every shed sample accounted."""
+
+    outdir = os.path.join(base_dir, "overrun_feed")
+    t0 = time.time()
+    # 4096-sample chunks -> 8 chunks; a 2-chunk queue bound with a
+    # wedged consumer sheds 6 of them, all journaled
+    step = 4096
+    sess = _feed_harness(outdir, path, plan=None, step=step, shed=2,
+                         consume_during_feed=False)
+    asm, health = sess["asm"], sess["health"]
+    delivered = sess["delivered"]
+    led = asm.ledger
+    shed_chunks = sorted(r["chunk"] for r in led.journal
+                         if r["reason"] == "shed_overrun")
+    bad = _chunks_identical(delivered, sess["block"],
+                            sorted(delivered), step)
+    audit_issues = _audit_feed(sess["manifest_path"], asm)
+    hrec = _health_record(health)
+    rec = {"recoverable": False, "fired": len(shed_chunks),
+           "wall_s": round(time.time() - t0, 2),
+           "feed_wall_s": round(sess["feed_wall_s"], 3),
+           "shed_chunks": shed_chunks,
+           "delivered_chunks": sorted(delivered),
+           "shed_samples": led.shed,
+           "unaccounted": led.unaccounted(),
+           "audit_ok": not audit_issues, "audit_issues": audit_issues,
+           "diffs": [f"chunk {s} differs" for s in bad],
+           "health": hrec,
+           "health_ok": hrec["worst"] == "CRITICAL"}
+    rec["ok"] = (len(shed_chunks) == 6 and not bad
+                 and led.shed == 6 * step
+                 and led.delivered == 2 * step
+                 and led.unaccounted() == 0
+                 and sess["feed_wall_s"] < 10.0      # reader never wedged
+                 and not audit_issues and rec["health_ok"])
     return rec
 
 
