@@ -2125,12 +2125,173 @@ def config23(quick):
           "feed_wall_s": round(feed_wall, 3)})
 
 
+def config24(quick):
+    """Capacity-observability A/B (ISSUE 20): the same 2-file survey
+    run through a 2-worker fleet twice —
+
+    * **off arm** — the plain fleet (capacity off, the pre-ISSUE-20
+      path): ``/fleet/capacity`` must serve an explicit
+      ``enabled: false`` refusal, never a guessed advice;
+    * **on arm** — capacity armed: worker utilization clocks +
+      busy-fraction gauges riding each ``complete``, the coordinator
+      deriving lease waits and folding per-worker EWMA throughput,
+      the saturation detector classifying every sweep, and the
+      scaling-advice engine served at ``/fleet/capacity``.
+
+    ``value`` is the off/on wall ratio (the layer's measured overhead;
+    ~1.0 expected) — FORCED to 0.0, far past any tolerance, when any
+    candidate/ledger byte diverges between the arms, when the armed
+    ``/fleet/capacity`` document is missing/disabled/evidence-free,
+    or when the advice points **up** on a drained fleet (the one
+    unambiguously wrong direction once the backlog is gone).
+    """
+    import glob
+    import json as _json
+    import tempfile
+    import threading
+    from urllib.request import urlopen
+
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+    from pulsarutils_tpu.models.simulate import disperse_array
+    from pulsarutils_tpu.obs.health import HealthEngine
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    tsamp, nchan = 0.0005, 64
+    hop = 4096 if quick else 8192
+    nhops = 6
+    nsamples = nhops * hop
+    config = dict(dmmin=100, dmmax=200, chunk_length=hop * tsamp,
+                  snr_threshold=6.5)
+    with tempfile.TemporaryDirectory() as tmp:
+        fnames = []
+        for i in range(2):
+            rng = np.random.default_rng(240 + i)
+            arr = np.abs(rng.normal(0, 0.5, (nchan, nsamples))) + 20.0
+            if i == 0:
+                arr[:, (3 * nsamples) // 4] += 4.0
+                arr = disperse_array(arr, 150.0, 1200., 200., tsamp)
+            header = {"bandwidth": 200., "fbottom": 1200.,
+                      "nchans": nchan, "nsamples": nsamples,
+                      "tsamp": tsamp, "foff": 200. / nchan}
+            path = os.path.join(tmp, f"survey{i}.fil")
+            write_simulated_filterbank(path, arr, header,
+                                       descending=True)
+            fnames.append(path)
+
+        def fleet_run(outdir, *, armed):
+            t0 = time.time()
+            coordinator = FleetCoordinator(
+                outdir, lease_ttl_s=120.0, chunks_per_unit=1,
+                probe_interval_s=0.2, capacity=armed,
+                health=HealthEngine() if armed else None)
+            server = start_obs_server(0, fleet=coordinator)
+            url = f"http://127.0.0.1:{server.port}"
+            coordinator.add_survey(fnames, **config)
+            workers = [FleetWorker(url, http_port=None)
+                       for _ in range(2)]
+            threads = [threading.Thread(target=w.run,
+                                        kwargs={"max_idle_s": 120.0})
+                       for w in workers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600.0)
+            wall = time.time() - t0
+            # one post-drain sweep so the armed detector sees the
+            # terminal state before the document is read
+            coordinator.sweep()
+            with urlopen(url + "/fleet/capacity", timeout=10.0) as resp:
+                doc = _json.loads(resp.read().decode())
+            progress = coordinator.progress_doc()
+            server.close()
+            coordinator.close()
+            return dict(wall=wall, progress=progress, doc=doc,
+                        workers=workers)
+
+        off = fleet_run(os.path.join(tmp, "off"), armed=False)
+        on = fleet_run(os.path.join(tmp, "on"), armed=True)
+
+        # identity: per-file ledger + candidate npz bytes between arms
+        # (the config-14/18 comparison rule)
+        identical = off["progress"]["survey_done"] \
+            and on["progress"]["survey_done"]
+        names = {os.path.basename(p)
+                 for d in ("off", "on")
+                 for p in glob.glob(os.path.join(tmp, d,
+                                                 "progress_*.json"))
+                 + glob.glob(os.path.join(tmp, d, "*.npz"))}
+        for name in sorted(names):
+            a_path = os.path.join(tmp, "off", name)
+            b_path = os.path.join(tmp, "on", name)
+            if not (os.path.exists(a_path) and os.path.exists(b_path)):
+                identical = False
+                log(f"config 24: {name} present in only one arm")
+                continue
+            if name.endswith(".json"):
+                with open(a_path, "rb") as fa, open(b_path, "rb") as fb:
+                    if fa.read() != fb.read():
+                        identical = False
+                        log(f"config 24: ledger bytes differ: {name}")
+            else:
+                with np.load(a_path, allow_pickle=False) as za, \
+                        np.load(b_path, allow_pickle=False) as zb:
+                    if set(za.files) != set(zb.files) or any(
+                            za[k].tobytes() != zb[k].tobytes()
+                            for k in za.files):
+                        identical = False
+                        log(f"config 24: candidate bytes differ: {name}")
+
+        # the armed document must be present AND evidenced: detector
+        # state, per-worker throughput behind the advice, an ETA seam
+        doc = on["doc"]
+        advice = doc.get("advice") or {}
+        observations = (doc.get("throughput") or {}).get(
+            "observations", 0)
+        doc_ok = (doc.get("enabled") is True
+                  and doc.get("state") in ("healthy", "worker-bound",
+                                           "starved", "draining")
+                  and observations > 0
+                  and advice.get("direction") in ("up", "down", "hold"))
+        if not doc_ok:
+            log(f"config 24: armed /fleet/capacity doc not evidenced: "
+                f"{doc}")
+        # the drained fleet has nothing left to scale for: "up" here is
+        # the wrong-direction advice the gate forces to 0.0
+        direction_ok = advice.get("direction") != "up"
+        if not direction_ok:
+            log(f"config 24: advice scales UP a drained fleet: {advice}")
+        off_refused = off["doc"].get("enabled") is False \
+            and bool(off["doc"].get("reason"))
+        if not off_refused:
+            log(f"config 24: capacity-off doc not an explicit refusal: "
+                f"{off['doc']}")
+        ok = identical and doc_ok and direction_ok and off_refused
+    emit({"config": 24, "metric": "capacity observability A/B: "
+          "2-worker fleet with utilization/saturation/scaling-advice "
+          f"armed vs off, 2 files x {nchan}x{nsamples}",
+          "value": round(off["wall"] / on["wall"], 4) if ok else 0.0,
+          "unit": "x (off/on wall; 0 = byte divergence, missing "
+                  "capacity doc, or wrong-direction advice)",
+          "identical": identical,
+          "doc_ok": bool(doc_ok),
+          "direction_ok": bool(direction_ok),
+          "off_refused": bool(off_refused),
+          "state": doc.get("state"),
+          "advice": advice,
+          "throughput_observations": observations,
+          "units_per_worker": [w.units_done for w in on["workers"]],
+          "off_wall_s": round(off["wall"], 2),
+          "on_wall_s": round(on["wall"], 2)})
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", type=int, nargs="*",
                         default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12,
                                  13, 14, 15, 16, 17, 18, 19, 20, 21,
-                                 22, 23])
+                                 22, 23, 24])
     parser.add_argument("--metrics-out", default=None, metavar="PATH",
                         help="write every config's JSON record plus a "
                              "final metrics-registry line to PATH (JSON "
@@ -2166,7 +2327,7 @@ def main(argv=None):
            11: config11, 12: config12, 13: config13, 14: config14,
            15: config15, 16: config16, 17: config17, 18: config18,
            19: config19, 20: config20, 21: config21, 22: config22,
-           23: config23}
+           23: config23, 24: config24}
     for c in opts.configs:
         log(f"=== config {c} ===")
         try:

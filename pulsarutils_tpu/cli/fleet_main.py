@@ -96,6 +96,14 @@ def build_parser():
                             "success) with burn-rate alerting: /alerts "
                             "endpoint + ALERTS_JSON footer (implies "
                             "--history-interval 5 when unset)")
+    coord.add_argument("--capacity", action="store_true",
+                       help="arm fleet capacity observability: "
+                            "saturation detection over queue-depth + "
+                            "utilization trends, backlog-drain ETA and "
+                            "scaling advice at /fleet/capacity, plus "
+                            "the fleet_saturated health condition when "
+                            "--slo is also armed.  Byte-inert: science "
+                            "outputs are identical either way")
 
     work = sub.add_parser("worker",
                           help="lease and search units from a "
@@ -195,7 +203,8 @@ def _run_coordinator(opts):
     kwargs = dict(lease_ttl_s=opts.lease_ttl,
                   chunks_per_unit=opts.chunks_per_unit,
                   probe_interval_s=opts.probe_interval,
-                  resume=not opts.no_resume, collector=collector)
+                  resume=not opts.no_resume, collector=collector,
+                  capacity=opts.capacity, health=health)
     if opts.recover:
         # crash restart (ISSUE 15): journal replay + ledger re-derive;
         # files the journal already names must not be re-sharded
@@ -255,6 +264,7 @@ def _run_coordinator(opts):
                            "output_dir": os.path.abspath(opts.output_dir)},
                      fleet=summary,
                      slo=engine.to_json() if engine is not None else None,
+                     capacity=summary.get("capacity"),
                      metrics=obs_metrics.REGISTRY.snapshot())
         logger.info("fleet report -> %s.md", opts.report_out)
     return 0 if summary["survey_done"] else 1

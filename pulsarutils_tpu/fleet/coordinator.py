@@ -26,8 +26,9 @@ primitives across processes:
 
 The HTTP surface rides the existing :class:`~pulsarutils_tpu.obs.
 server.ObsServer` (``start_obs_server(..., fleet=coordinator)``):
-``GET /fleet/workers`` / ``/fleet/leases`` / ``/fleet/progress`` and
-the fleet-aggregated ``GET /fleet/metrics`` (every worker's last
+``GET /fleet/workers`` / ``/fleet/leases`` / ``/fleet/progress`` /
+``/fleet/capacity`` (the saturation state + scaling advice, ISSUE 20)
+and the fleet-aggregated ``GET /fleet/metrics`` (every worker's last
 reported registry snapshot re-exposed as one Prometheus page with a
 ``worker`` label), plus the four POST messages of the protocol.
 """
@@ -42,6 +43,7 @@ import time
 
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
+from ..obs.capacity import CapacityModel, SaturationDetector
 from ..utils.logging_utils import logger
 from . import protocol
 
@@ -154,13 +156,26 @@ class FleetCoordinator:
     ``auto_sweep=True`` runs lease expiry + health probes on a daemon
     thread every ``probe_interval_s``; tests pass ``False`` and drive
     :meth:`sweep` deterministically.
+
+    ``capacity=True`` (ISSUE 20, default-off and byte-inert) arms the
+    capacity observability layer: the sweep classifies fleet
+    saturation (:class:`~pulsarutils_tpu.obs.capacity.
+    SaturationDetector`), samples queue-depth/utilization gauges, and
+    turns the always-on EWMA throughput model into a
+    :class:`~pulsarutils_tpu.obs.capacity.ScalingAdvice` served at
+    ``GET /fleet/capacity`` and rolled into :meth:`summary`.
+    ``health`` accepts the coordinator-side
+    :class:`~pulsarutils_tpu.obs.health.HealthEngine` the
+    ``fleet_saturated`` condition is raised on (the same engine the
+    SLO engine feeds).
     """
 
     def __init__(self, output_dir, *, lease_ttl_s=30.0, chunks_per_unit=1,
                  probe_interval_s=1.0, probe_timeout_s=2.0, dead_after=3,
                  poll_s=0.25, resume=True, file_affinity=True,
                  max_attempts=5, auto_sweep=True, collector=None,
-                 scrape_history=True, journal=True):
+                 scrape_history=True, journal=True, capacity=False,
+                 health=None):
         from .journal import FleetJournal
 
         self.output_dir = str(output_dir)
@@ -201,6 +216,18 @@ class FleetCoordinator:
         self._stats = {"granted": 0, "expired": 0, "revoked": 0,
                        "denied": 0, "requeued": 0, "completed": 0,
                        "failed": 0, "duplicates": 0, "stale_epochs": 0}
+        #: capacity observability (ISSUE 20).  The EWMA throughput
+        #: model is ALWAYS maintained (it feeds /fleet/progress ETAs
+        #: and costs one fold per completion); the detector, gauges,
+        #: scaling advice and ``fleet_saturated`` condition only run
+        #: when ``capacity=True`` — and none of it touches science
+        #: bytes either way (pinned by tests + bench config 24).
+        self.capacity_enabled = bool(capacity)
+        self.health = health
+        self.capacity_model = CapacityModel()
+        self.saturation = SaturationDetector() if capacity else None
+        self._advice = None
+        self._saturated_raised = False
         self._closed = False
         self._sweeper = None
         if auto_sweep:
@@ -905,6 +932,21 @@ class FleetCoordinator:
                 del self._leases[lease_id]
                 self._end_lease_span_locked(
                     lease, "completed" if error is None else "error")
+                # capacity signals (ISSUE 20): the worker-reported unit
+                # wall splits grant→resolution into queue wait (the
+                # lease sat granted before work started — the
+                # queue-wait p95 SLO's indicator) and throughput (the
+                # EWMA chunks/s behind every ETA and ScalingAdvice).
+                # Absent on an old worker: skipped, never guessed.
+                wall = doc.get("unit_wall_s")
+                if isinstance(wall, (int, float)) and wall >= 0:
+                    wait = max(0.0,
+                               time.time() - lease.granted_at - wall)
+                    _metrics.histogram(
+                        "putpu_lease_wait_seconds").observe(wait)
+                    if error is None:
+                        self.capacity_model.note_unit(
+                            worker_id, len(unit.chunks), float(wall))
             else:
                 # the lease was already expired/revoked and possibly
                 # re-granted: the straggler finished anyway.  Its ledger
@@ -1153,8 +1195,103 @@ class FleetCoordinator:
                         revoked += self._revoke_worker_locked(
                             worker_id, done_cache, "verdict CRITICAL")
             self._update_gauges_locked()
+            if self.capacity_enabled:
+                self._capacity_sweep_locked()
         return {"expired": expired, "revoked": revoked,
                 "probed": {w: v for w, v in probes.items()}}
+
+    # -- capacity observability (ISSUE 20) -----------------------------------
+
+    def _fleet_utilization_locked(self):
+        """Mean ``putpu_worker_busy_fraction`` over alive workers that
+        have reported one (``None`` without evidence — no verdict)."""
+        fracs = []
+        for w in self._workers.values():
+            if not w.alive or not w.metrics:
+                continue
+            for rec in w.metrics:
+                if rec.get("name") == "putpu_worker_busy_fraction" \
+                        and (rec.get("labels") or {}).get("worker") \
+                        == w.id and rec.get("value") is not None:
+                    fracs.append(float(rec["value"]))
+        if not fracs:
+            return None
+        return sum(fracs) / len(fracs)
+
+    def _backlog_chunks_locked(self):
+        """Chunks not yet resolved: the backlog the drain ETA prices."""
+        return sum(len(u.chunks) for u in self._units.values()
+                   if u.state not in _TERMINAL)
+
+    def _capacity_sweep_locked(self):
+        """One armed sweep's capacity pass: classify saturation, sample
+        the gauges the time-series ring picks up, refresh the scaling
+        advice, and raise/resolve the ``fleet_saturated`` condition."""
+        depth = len(self._pending)
+        util = self._fleet_utilization_locked()
+        n_alive = sum(1 for w in self._workers.values() if w.alive)
+        draining = self._survey_done_locked() or (
+            bool(self._workers)
+            and all(w.draining for w in self._workers.values()))
+        state = self.saturation.observe(depth, util, draining=draining)
+        backlog = self._backlog_chunks_locked()
+        advice = self.capacity_model.advise(backlog, n_alive, state)
+        self._advice = advice
+        _metrics.gauge("putpu_capacity_queue_depth").set(depth)
+        if util is not None:
+            _metrics.gauge("putpu_capacity_utilization").set(
+                round(util, 4))
+        _metrics.gauge("putpu_capacity_desired_workers").set(
+            advice.desired_workers)
+        eta = self.capacity_model.eta_s(backlog, n_alive)
+        if eta is not None:
+            _metrics.gauge("putpu_capacity_backlog_eta_seconds").set(
+                round(eta, 3))
+        if self.health is not None:
+            if state == "worker-bound":
+                from ..obs.health import DEGRADED
+
+                self.health.note_alert(
+                    "fleet_saturated", DEGRADED,
+                    f"fleet worker-bound: queue depth {depth} growing "
+                    f"with utilization "
+                    f"{'unknown' if util is None else f'{util:.2f}'} — "
+                    f"advice: scale to {advice.desired_workers} "
+                    "worker(s)")
+                self._saturated_raised = True
+            elif self._saturated_raised:
+                self.health.resolve_alert("fleet_saturated")
+                self._saturated_raised = False
+
+    def capacity_doc(self):
+        """The ``GET /fleet/capacity`` document — the autoscaler's
+        input record.  Capacity-off serves an explicit refusal, not a
+        guessed advice."""
+        if not self.capacity_enabled:
+            return {"enabled": False,
+                    "reason": "capacity observability off "
+                              "(FleetCoordinator(capacity=True) or "
+                              "PUfleet coordinator --capacity arms it)"}
+        with self._lock:
+            n_alive = sum(1 for w in self._workers.values() if w.alive)
+            backlog = self._backlog_chunks_locked()
+            advice = self._advice
+            doc = {
+                "enabled": True,
+                "state": self.saturation.state,
+                "saturation": self.saturation.doc(),
+                "queue_depth": len(self._pending),
+                "backlog_chunks": backlog,
+                "workers_alive": n_alive,
+                "utilization": (None if (u := self
+                                         ._fleet_utilization_locked())
+                                is None else round(u, 4)),
+                "throughput": self.capacity_model.doc(),
+                "eta_s": (None if (e := self.capacity_model.eta_s(
+                    backlog, n_alive)) is None else round(e, 3)),
+                "advice": advice.doc() if advice is not None else None,
+            }
+        return doc
 
     def _probe_one(self, url):
         """One ``/healthz`` probe; the verdict string, or ``None`` when
@@ -1250,10 +1387,20 @@ class FleetCoordinator:
             states = {}
             for unit in self._units.values():
                 states[unit.state] = states.get(unit.state, 0) + 1
+            total = sum(f["chunks_total"] for f in files)
+            done = sum(f["chunks_done"] for f in files)
+            # ETA from the EWMA throughput model (ISSUE 20 satellite):
+            # tracks the CURRENT fleet rate instead of extrapolating
+            # done/elapsed, which misleads mid-survey when chunk walls
+            # drift.  None until any unit wall has been reported.
+            n_alive = sum(1 for w in self._workers.values() if w.alive)
+            eta = self.capacity_model.eta_s(max(total - done, 0),
+                                            n_alive)
             return {
                 "files": files,
-                "chunks_total": sum(f["chunks_total"] for f in files),
-                "chunks_done": sum(f["chunks_done"] for f in files),
+                "chunks_total": total,
+                "chunks_done": done,
+                "eta_s": None if eta is None else round(eta, 1),
                 "units": states,
                 "workers": {"registered": len(self._workers),
                             "alive": sum(1 for w in
@@ -1358,6 +1505,12 @@ class FleetCoordinator:
                               if v}
         if push:
             out["push"] = {k: push[k] for k in sorted(push)}
+        if self.capacity_enabled:
+            # capacity & scaling rollup (ISSUE 20): the report's
+            # "Capacity & scaling" section and the coordinator
+            # summary's autoscaler-facing record.  Absent when the
+            # layer is off — the report states the absence.
+            out["capacity"] = self.capacity_doc()
         return out
 
     @property

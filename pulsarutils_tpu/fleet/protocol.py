@@ -22,12 +22,19 @@ lease      ``{"worker": id, "max_units": n, "health": {verdict
            echoes it back, so post-steal stragglers are detectably
            stale
 complete   ``{"worker", "lease", "unit", "error": str|null,
-           "epoch": int|absent,
+           "epoch": int|absent, "unit_wall_s": float|absent,
            "metrics": [registry snapshot], "health": {verdict doc}}``
            -> ``{"ok", "unit_done", "requeued": [chunks],
            "survey_done"}`` — a stale ``epoch`` is rejected
            idempotently: ``{"ok": true, "stale": true, ...}``,
-           counted, never fatal
+           counted, never fatal.  ``unit_wall_s`` (ISSUE 20,
+           absent-field back-compat) is the worker's busy wall for
+           the unit: the coordinator derives the grant-to-work lease
+           wait from it and folds it into the EWMA throughput model
+           behind ``/fleet/capacity``; the worker's utilization
+           gauges (``putpu_worker_busy_fraction`` /
+           ``putpu_worker_duty_cycle``) ride the same ``metrics``
+           snapshot
 release    ``{"worker", "leases": [ids], "epochs": {id: epoch}|absent,
            "reason": str}`` ->
            ``{"ok", "requeued": n}`` (graceful drain: unstarted

@@ -34,6 +34,7 @@ re-search is idempotent — proven byte-identical in
 from __future__ import annotations
 
 import os
+import random
 import signal
 import threading
 import time
@@ -43,6 +44,7 @@ import contextlib
 from ..faults import inject as fault_inject
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
+from ..obs.capacity import UtilizationAccountant
 from ..obs.collector import clock_offset
 from ..obs.health import HealthEngine
 from ..obs.server import start_obs_server
@@ -122,6 +124,16 @@ class FleetWorker:
         self._drain = threading.Event()
         self._server = None
         self._lease_ttl_s = None
+        #: capacity observability (ISSUE 20): busy/idle wall accounting
+        #: behind the ``putpu_worker_busy_fraction`` /
+        #: ``putpu_worker_duty_cycle`` gauges each ``complete`` carries
+        self.util = UtilizationAccountant()
+        #: jittered exponential idle-poll backoff: consecutive empty
+        #: polls double the wait up to this cap, so N idle workers stop
+        #: hammering the coordinator in lockstep; any granted lease
+        #: resets the streak to the plain ``poll_s`` cadence
+        self.idle_backoff_cap_s = 2.0
+        self._idle_streak = 0
         self._floor_cache = {}   # fname -> minimum-footprint estimate
         #: distributed tracing (ISSUE 14): ``trace=True`` gives this
         #: worker its OWN tracer (a contextvar override, so N
@@ -424,10 +436,51 @@ class FleetWorker:
                          self.worker_id, lease["unit"], exc)
             return repr(exc)
 
-    def _complete(self, lease, error):
+    @staticmethod
+    def _chunk_wall_sum():
+        """Summed ``putpu_chunk_wall_seconds`` so far (the budget
+        layer's dispatch→ready chunk spans) — read via snapshot so this
+        never *creates* the histogram with the wrong edges."""
+        return sum(m.get("sum", 0.0)
+                   for m in _metrics.REGISTRY.snapshot()
+                   if m.get("name") == "putpu_chunk_wall_seconds")
+
+    def _idle_wait(self):
+        """One idle/backoff wait; returns True when a drain landed
+        during it.  The wait doubles per consecutive idle poll (capped,
+        jittered by up to one ``poll_s`` so idle workers desynchronize)
+        and the elapsed time lands on the utilization ledger's idle
+        side."""
+        base = self.poll_s or 0.25
+        wait = min(base * (2 ** self._idle_streak),
+                   max(base, self.idle_backoff_cap_s))
+        wait += random.uniform(0.0, base)
+        self._idle_streak = min(self._idle_streak + 1, 8)
+        t0 = time.monotonic()
+        drained = self._drain.wait(wait)
+        self.util.note_idle(time.monotonic() - t0)
+        return drained
+
+    def _complete(self, lease, error, unit_wall_s=None):
+        # utilization gauges ride the snapshot below: refresh them
+        # first so the coordinator's saturation detector always sees
+        # the post-unit fractions (ISSUE 20)
+        frac = self.util.busy_fraction()
+        if frac is not None:
+            _metrics.gauge("putpu_worker_busy_fraction",
+                           worker=self.worker_id).set(round(frac, 4))
+        duty = self.util.duty_cycle()
+        if duty is not None:
+            _metrics.gauge("putpu_worker_duty_cycle",
+                           worker=self.worker_id).set(round(duty, 4))
         doc = {
             "worker": self.worker_id, "lease": lease["lease"],
             "unit": lease["unit"], "error": error,
+            # the unit's measured wall (ISSUE 20): the coordinator
+            # derives grant→work lease wait and the per-worker EWMA
+            # throughput from it; absent on an old worker = skipped
+            **({"unit_wall_s": round(unit_wall_s, 4)}
+               if unit_wall_s is not None else {}),
             # echo the fencing token: a stale-epoch completion (this
             # lease was stolen while we computed) is rejected
             # idempotently on the coordinator — counted, never fatal
@@ -544,7 +597,7 @@ class FleetWorker:
                             "past %.1fs, exiting", self.worker_id,
                             max_idle_s)
                         break
-                    if self._drain.wait(self.poll_s):
+                    if self._idle_wait():
                         break
                     continue
                 leases = resp.get("leases") or []
@@ -553,6 +606,11 @@ class FleetWorker:
                         logger.info("fleet worker %s: survey complete",
                                     self.worker_id)
                         break
+                    # the utilization denominator (ISSUE 20): every
+                    # empty poll is counted, and the backoff below
+                    # keeps N of them from arriving in lockstep
+                    _metrics.counter(
+                        "putpu_fleet_idle_polls_total").inc()
                     if resp.get("denied"):
                         logger.info(
                             "fleet worker %s: leases denied (%s) — "
@@ -574,10 +632,11 @@ class FleetWorker:
                         logger.info("fleet worker %s: idle past %.1fs, "
                                     "exiting", self.worker_id, max_idle_s)
                         break
-                    if self._drain.wait(self.poll_s):
+                    if self._idle_wait():
                         break
                     continue
                 idle_since = None
+                self._idle_streak = 0
                 for i, lease in enumerate(leases):
                     if self._drain.is_set():
                         # unstarted leases go straight back; the
@@ -597,9 +656,15 @@ class FleetWorker:
                             lease["unit"])
                         self._release([lease], "too_large")
                         continue
+                    t_unit0 = time.monotonic()
+                    dev0 = self._chunk_wall_sum()
                     error = self._run_unit(lease)
+                    unit_wall = time.monotonic() - t_unit0
+                    self.util.note_busy(unit_wall)
+                    self.util.note_device(self._chunk_wall_sum() - dev0)
                     try:
-                        self._complete(lease, error)
+                        self._complete(lease, error,
+                                       unit_wall_s=unit_wall)
                     except (OSError, ValueError) as exc:
                         logger.warning(
                             "fleet worker %s: completion report for %s "

@@ -89,6 +89,16 @@ METRIC_NAMES = {
     "putpu_candidate_stage_seconds":
         "histogram of per-stage candidate latency (labelled by stage: "
         "read/dispatch/device/sift/persist/alert)",
+    "putpu_capacity_backlog_eta_seconds":
+        "estimated seconds to drain the unresolved chunk backlog at "
+        "the EWMA fleet throughput",
+    "putpu_capacity_desired_workers":
+        "worker count the scaling-advice engine currently recommends",
+    "putpu_capacity_queue_depth":
+        "pending work units sampled by the capacity-armed sweep",
+    "putpu_capacity_utilization":
+        "mean busy fraction over alive workers (the saturation "
+        "detector's utilization input)",
     "putpu_certified_chunks_total":
         "chunks whose hybrid noise certificate held",
     "putpu_chunks_per_s":
@@ -136,6 +146,9 @@ METRIC_NAMES = {
         "candidate artifact writes refused by the lease-epoch fence "
         "(a stolen lease's zombie tried to clobber the new owner's "
         "output)",
+    "putpu_fleet_idle_polls_total":
+        "lease polls that returned no work (the utilization "
+        "denominator; each one backs the poll interval off, jittered)",
     "putpu_fleet_journal_records_total":
         "records appended to the coordinator write-ahead journal",
     "putpu_fleet_journal_replayed_total":
@@ -214,6 +227,10 @@ METRIC_NAMES = {
         "service jobs reaching a terminal state (labelled by status)",
     "putpu_jobs_submitted_total":
         "jobs accepted by the survey service",
+    "putpu_lease_wait_seconds":
+        "histogram of grant-to-work lease wait seconds (grant to "
+        "resolution minus the worker-reported unit wall; the "
+        "queue-wait p95 SLO's source)",
     "putpu_lineage_docs_total":
         "per-candidate lineage documents persisted beside the npz",
     "putpu_metric_history_samples_total":
@@ -342,6 +359,12 @@ METRIC_NAMES = {
         "over the register/lease exchange (labelled by worker)",
     "putpu_trace_spans_collected_total":
         "worker span events stitched into the fleet trace collector",
+    "putpu_worker_busy_fraction":
+        "worker search wall over search + lease-poll wall (labelled "
+        "by worker; rides each complete's metrics snapshot)",
+    "putpu_worker_duty_cycle":
+        "device-span seconds over the worker's busy wall (labelled by "
+        "worker; dispatch-to-ready duty vs per-unit overhead)",
 }
 
 #: per-chunk budget counters mirrored dynamically by

@@ -46,7 +46,8 @@ def _fmt(v, nd=3):
 def build_report(*, meta=None, budget=None, roofline=None, health=None,
                  canary=None, quarantine=None, sift=None, metrics=None,
                  coincidence=None, fleet=None, periodicity=None,
-                 slo=None, lineage=None, push=None, ingest=None):
+                 slo=None, lineage=None, push=None, ingest=None,
+                 capacity=None):
     """Assemble the structured report record (JSON-ready).
 
     ``meta``: run header dict; ``budget``: ``BudgetAccountant.to_json()``;
@@ -67,7 +68,9 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
     "Candidate latency" per-stage waterfall (ISSUE 18); ``push``:
     ``AlertBroker.stats()`` — the "Alert push" delivery table
     (ISSUE 18); ``ingest``: ``ChunkAssembler.summary()`` — the
-    "Ingest" feed/loss/shed accounting section (ISSUE 19).
+    "Ingest" feed/loss/shed accounting section (ISSUE 19);
+    ``capacity``: ``FleetCoordinator.capacity_doc()`` — the
+    "Capacity & scaling" saturation/advice section (ISSUE 20).
     """
     rec = {
         "generated": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -85,6 +88,7 @@ def build_report(*, meta=None, budget=None, roofline=None, health=None,
         "lineage": lineage,
         "push": push,
         "ingest": ingest,
+        "capacity": capacity,
     }
     if metrics:
         totals = {}
@@ -451,6 +455,55 @@ def render_markdown(rec):
         lines.append("Single-process run: no fleet coordinator was "
                      "involved.")
     lines.append("")
+
+    lines.append("## Capacity & scaling")
+    lines.append("")
+    capacity = rec.get("capacity")
+    if capacity and capacity.get("enabled"):
+        util = capacity.get("utilization")
+        eta = capacity.get("eta_s")
+        lines.append(
+            f"Saturation state **{capacity.get('state')}**; queue depth "
+            f"{capacity.get('queue_depth', 0)}, backlog "
+            f"{capacity.get('backlog_chunks', 0)} chunk(s) over "
+            f"{capacity.get('workers_alive', 0)} alive worker(s); mean "
+            f"utilization {_fmt(util, 2)}; backlog-drain ETA "
+            f"{_fmt(eta, 1)}s at the EWMA fleet rate.")
+        lines.append("")
+        advice = capacity.get("advice")
+        if advice:
+            lines.append(_md_table(
+                ("desired workers", "direction", "confidence", "reason"),
+                [(advice.get("desired_workers"),
+                  advice.get("direction"),
+                  _fmt(advice.get("confidence"), 2),
+                  advice.get("reason"))]))
+            lines.append("")
+        else:
+            lines.append("No scaling advice yet (no capacity-armed "
+                         "sweep ran).")
+            lines.append("")
+        rates = (capacity.get("throughput") or {}).get("per_worker_rate")
+        if rates:
+            lines.append("Per-worker EWMA throughput (chunks/s, the "
+                         "ETA and advice substrate):")
+            lines.append("")
+            lines.append(_md_table(
+                ("worker", "chunks/s", "observations"),
+                [(w, _fmt(r.get("rate"), 4), r.get("n"))
+                 for w, r in sorted(rates.items())]))
+            lines.append("")
+        trans = (capacity.get("saturation") or {}).get("transitions")
+        if trans:
+            lines.append(_md_table(
+                ("t", "from", "to"),
+                [(t["t"], t["from"], t["to"]) for t in trans]))
+            lines.append("")
+    else:
+        lines += ["Capacity observability was off (arm with "
+                  "`FleetCoordinator(capacity=True)` / `--capacity`): "
+                  "saturation and scaling advice were NOT measured for "
+                  "this run.", ""]
 
     lines.append("## Periodicity search")
     lines.append("")

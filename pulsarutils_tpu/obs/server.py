@@ -162,12 +162,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, json.dumps(doc, indent=1), "application/json")
 
     def _get_fleet(self, srv, path):
-        """GET /fleet/{workers,leases,progress,metrics}: the
+        """GET /fleet/{workers,leases,progress,capacity,metrics}: the
         coordinator's read surface (ISSUE 9).  ``/fleet/metrics`` is
         the fleet-AGGREGATED Prometheus page — every worker's last
         reported registry snapshot with a ``worker`` label — while the
         coordinator process's own registry stays on plain
-        ``/metrics``."""
+        ``/metrics``.  ``/fleet/capacity`` (ISSUE 20) serves the
+        saturation state + scaling advice the future autoscaler
+        consumes (an explicit ``enabled: false`` refusal when the
+        coordinator runs capacity-off)."""
         if srv.fleet is None:
             self._send(404, "no fleet coordinator wired (start the "
                        "server with fleet=FleetCoordinator(...))\n",
@@ -180,6 +183,7 @@ class _Handler(BaseHTTPRequestHandler):
         docs = {"/fleet/workers": srv.fleet.workers_doc,
                 "/fleet/leases": srv.fleet.leases_doc,
                 "/fleet/progress": srv.fleet.progress_doc,
+                "/fleet/capacity": srv.fleet.capacity_doc,
                 "/fleet/history": srv.fleet.fleet_history_doc}
         fn = docs.get(path)
         if fn is None:

@@ -185,12 +185,13 @@ class Alert:
 
 def default_slos(*, chunk_wall_p95_s=60.0, recall_floor=0.7,
                  dispatch_objective=0.95, lease_objective=0.9,
-                 candidate_latency_p95_s=30.0):
-    """The framework's stock SLO set (ISSUE 14/18): dispatch success,
-    chunk-wall p95, the canary recall floor, fleet lease success, and
-    end-to-end candidate latency p95.  Bounds are constructor knobs — a
-    deployment tunes them per hardware; the defaults are deliberately
-    loose (the engine flags budget *burn*, not scheduler noise)."""
+                 candidate_latency_p95_s=30.0, queue_wait_p95_s=10.0):
+    """The framework's stock SLO set (ISSUE 14/18/20): dispatch
+    success, chunk-wall p95, the canary recall floor, fleet lease
+    success, end-to-end candidate latency p95, and fleet queue-wait
+    p95.  Bounds are constructor knobs — a deployment tunes them per
+    hardware; the defaults are deliberately loose (the engine flags
+    budget *burn*, not scheduler noise)."""
     return [
         SLOSpec("dispatch-success", objective=dispatch_objective,
                 kind="ratio", bad="putpu_dispatch_retries_total",
@@ -222,6 +223,14 @@ def default_slos(*, chunk_wall_p95_s=60.0, recall_floor=0.7,
                             "read to persist complete, the lineage "
                             "histogram) stays under the real-time "
                             "alerting bound — ISSUE 18"),
+        SLOSpec("queue-wait-p95", objective=0.9, kind="threshold",
+                series="putpu_lease_wait_seconds", field="p95",
+                bound=queue_wait_p95_s, op="<=",
+                description="p95 grant-to-work lease wait stays under "
+                            "the queueing bound — a sustained breach "
+                            "means units sit granted while workers "
+                            "churn, the saturation signal the capacity "
+                            "layer classifies (ISSUE 20)"),
     ]
 
 

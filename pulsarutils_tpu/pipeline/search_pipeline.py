@@ -40,6 +40,7 @@ from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import roofline
 from ..obs.canary import CanaryController
+from ..obs.capacity import EwmaThroughput
 from ..obs.health import HealthEngine
 from ..obs.server import start_obs_server
 from ..obs.lineage import LineageRecorder
@@ -860,6 +861,11 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
     if http_port is not None and health is None:
         health = HealthEngine()
     t_run0 = time.time()
+    # EWMA chunk throughput (ISSUE 20): the /progress ETA follows the
+    # CURRENT rate, so one slow warm-up/compile chunk stops poisoning
+    # the estimate after a few folds.  The lifetime mean stays as the
+    # fallback until the model has evidence.
+    eta_model = EwmaThroughput()
 
     def _progress_snapshot():
         """The ``/progress`` document (read from the scrape thread —
@@ -867,12 +873,13 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
         done = nproc
         total = len(todo)
         elapsed = time.time() - t_run0
-        rate = done / elapsed if elapsed > 0 and done else None
+        eta = eta_model.eta_s(max(total - done, 0))
+        if eta is None and done and elapsed > 0:
+            eta = (total - done) * elapsed / done
         doc = {"fname": os.path.basename(str(fname)),
                "chunks_done": done, "chunks_total": total,
                "elapsed_s": round(elapsed, 1),
-               "eta_s": (round((total - done) / rate, 1)
-                         if rate else None),
+               "eta_s": None if eta is None else round(eta, 1),
                "hits": len(hits), "certified": ncertified,
                "quarantined": len(store.quarantined_chunks)}
         if canary is not None:
@@ -904,6 +911,12 @@ def search_by_chunks(fname, chunk_length=None, new_sample_time=None, tmin=0,
 
     def _health_update(istart, wall_s, candidates=None, quarantined=False,
                        headroom_frac=None, oom_floor=False):
+        # every completion path lands here, so this is where the ETA
+        # model folds — quarantined chunks count too (they drain the
+        # backlog just the same).  wall_s is None on the tail flush:
+        # nothing completed, nothing to fold.
+        if wall_s is not None:
+            eta_model.note(1, wall_s)
         if health is None:
             return
         deltas = {}

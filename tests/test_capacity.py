@@ -1,0 +1,375 @@
+"""Fleet capacity observability tests (ISSUE 20).
+
+Tier-1 pins: fake-clock utilization accounting, the EWMA throughput
+model, saturation-detector transitions (hysteresis both ways, decay
+back to healthy), scaling-advice direction on synthetic load curves,
+the grant-to-work lease-wait histogram fed from ``complete``'s
+``unit_wall_s``, worker idle-poll backoff, the ``/fleet/capacity``
+HTTP document + report section, and the byte-inertness contract:
+a capacity-armed 2-worker fleet run is byte-identical to a
+capacity-off one (and both to the single-process reference).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+from pulsarutils_tpu.fleet.worker import FleetWorker
+from pulsarutils_tpu.io.candidates import CandidateStore
+from pulsarutils_tpu.io.sigproc import write_simulated_filterbank
+from pulsarutils_tpu.models.simulate import disperse_array
+from pulsarutils_tpu.obs import metrics as obs_metrics
+from pulsarutils_tpu.obs.capacity import (CapacityModel, EwmaThroughput,
+                                          SaturationDetector,
+                                          UtilizationAccountant)
+from pulsarutils_tpu.obs.health import HealthEngine
+from pulsarutils_tpu.obs.report import build_report, render_markdown
+from pulsarutils_tpu.obs.server import start_obs_server
+from pulsarutils_tpu.pipeline.search_pipeline import search_by_chunks
+
+TSAMP = 0.0005
+NCHAN = 64
+NSAMPLES = 24576  # chunks [0, 8192] at chunk_length 8192*TSAMP
+CONFIG = dict(dmmin=100, dmmax=200, chunk_length=8192 * TSAMP,
+              snr_threshold=6.5)
+
+
+def write_file(path, seed=0, pulse=True):
+    rng = np.random.default_rng(seed)
+    arr = np.abs(rng.normal(0, 0.5, (NCHAN, NSAMPLES))) + 20.0
+    if pulse:
+        arr[:, (3 * NSAMPLES) // 4] += 4.0
+        arr = disperse_array(arr, 150.0, 1200., 200., TSAMP)
+    header = {"bandwidth": 200., "fbottom": 1200., "nchans": NCHAN,
+              "nsamples": NSAMPLES, "tsamp": TSAMP,
+              "foff": 200. / NCHAN}
+    write_simulated_filterbank(str(path), arr, header, descending=True)
+    return str(path)
+
+
+def snapshot_dir(outdir):
+    import glob
+
+    out = {}
+    for path in sorted(glob.glob(os.path.join(str(outdir), "*"))):
+        name = os.path.basename(path)
+        if name.startswith("progress_") and name.endswith(".json"):
+            with open(path, "rb") as f:
+                out[name] = f.read()
+        elif name.endswith(".npz"):
+            with np.load(path, allow_pickle=False) as z:
+                out[name] = {k: (str(z[k].dtype), z[k].shape,
+                                 z[k].tobytes()) for k in z.files}
+    return out
+
+
+def histogram_count(name):
+    return sum(m.get("count", 0) for m in obs_metrics.REGISTRY.snapshot()
+               if m.get("name") == name)
+
+
+# ---------------------------------------------------------------------------
+# utilization accounting (fake clocks: pure arithmetic)
+# ---------------------------------------------------------------------------
+
+def test_utilization_accountant_fake_clock_math():
+    util = UtilizationAccountant()
+    # no evidence -> no verdict, never a fake "fully idle" 0.0
+    assert util.busy_fraction() is None
+    assert util.duty_cycle() is None
+    util.note_busy(6.0)
+    util.note_idle(2.0)
+    util.note_busy(2.0)
+    util.note_device(4.0)
+    assert util.busy_fraction() == pytest.approx(0.8)   # 8 / (8 + 2)
+    assert util.duty_cycle() == pytest.approx(0.5)      # 4 / 8
+    # negative deltas (clock hiccups) are clamped, not subtracted
+    util.note_idle(-5.0)
+    assert util.busy_fraction() == pytest.approx(0.8)
+    doc = util.doc()
+    assert doc["busy_s"] == 8.0 and doc["idle_s"] == 2.0
+    assert doc["busy_fraction"] == pytest.approx(0.8)
+
+
+def test_duty_cycle_clamped_to_one():
+    util = UtilizationAccountant()
+    util.note_busy(1.0)
+    util.note_device(3.0)  # shared in-process histogram can overcount
+    assert util.duty_cycle() == 1.0
+
+
+def test_ewma_throughput_tracks_current_rate():
+    tp = EwmaThroughput(alpha=0.5)
+    assert tp.eta_s(10) is None          # no evidence, no ETA
+    tp.note(1, 1.0)                      # 1 chunk/s
+    assert tp.rate == pytest.approx(1.0)
+    tp.note(1, 0.25)                     # the fleet sped up to 4/s
+    assert tp.rate == pytest.approx(2.5)  # 0.5*4 + 0.5*1
+    assert tp.eta_s(5) == pytest.approx(2.0)
+    # zero/negative walls are dropped, never folded
+    tp.note(1, 0.0)
+    tp.note(1, -3.0)
+    assert tp.rate == pytest.approx(2.5) and tp.n == 2
+
+
+# ---------------------------------------------------------------------------
+# saturation detector: transitions + hysteresis + decay
+# ---------------------------------------------------------------------------
+
+def test_detector_worker_bound_needs_confirmation():
+    det = SaturationDetector(confirm=2, decay=3)
+    t = iter(range(100))
+    assert det.observe(1, 0.9, now=next(t)) == "healthy"
+    # first rising-depth sample is a candidate, not yet a transition
+    assert det.observe(3, 0.9, now=next(t)) == "healthy"
+    assert det.observe(5, 0.9, now=next(t)) == "worker-bound"
+    assert [(a, b) for _, a, b in det.transitions] \
+        == [("healthy", "worker-bound")]
+
+
+def test_detector_decay_back_to_healthy_is_slower():
+    det = SaturationDetector(confirm=2, decay=3)
+    for i, depth in enumerate((1, 3, 5)):
+        det.observe(depth, 0.9, now=i)
+    assert det.state == "worker-bound"
+    # the backlog stops growing: three healthy observations to clear
+    assert det.observe(5, 0.5, now=10) == "worker-bound"
+    assert det.observe(4, 0.5, now=11) == "worker-bound"
+    assert det.observe(3, 0.5, now=12) == "healthy"
+    assert [(a, b) for _, a, b in det.transitions] \
+        == [("healthy", "worker-bound"), ("worker-bound", "healthy")]
+
+
+def test_detector_starved_and_draining():
+    det = SaturationDetector(confirm=2, decay=3)
+    det.observe(0, 0.1, now=0)
+    assert det.observe(0, 0.1, now=1) == "starved"
+    # unknown utilization must NOT read as starved
+    det2 = SaturationDetector(confirm=1)
+    assert det2.observe(0, None, now=0) == "healthy"
+    # draining overrides everything
+    det3 = SaturationDetector(confirm=1)
+    assert det3.observe(7, 0.9, now=0, draining=True) == "draining"
+
+
+def test_detector_one_noisy_sweep_does_not_flap():
+    det = SaturationDetector(confirm=2, decay=3)
+    det.observe(1, 0.9, now=0)
+    det.observe(4, 0.9, now=1)   # one rising sample
+    det.observe(2, 0.4, now=2)   # ...that subsides immediately
+    assert det.state == "healthy" and det.transitions == []
+
+
+# ---------------------------------------------------------------------------
+# capacity model: advice direction on synthetic load curves
+# ---------------------------------------------------------------------------
+
+def test_advice_withheld_without_throughput_evidence():
+    model = CapacityModel()
+    advice = model.advise(10, 2, "worker-bound")
+    assert advice.direction == "hold" and advice.confidence == 0.0
+    assert "withheld" in advice.reason
+
+
+def test_advice_scales_up_under_saturated_load_curve():
+    model = CapacityModel(target_drain_s=100.0)
+    # a slow fleet: each worker drains 0.1 chunk/s, backlog 100 chunks
+    for _ in range(4):
+        model.note_unit("w1", 1, 10.0)
+        model.note_unit("w2", 1, 10.0)
+    advice = model.advise(100, 2, "worker-bound")
+    assert advice.direction == "up"
+    # 100 chunks / (0.1 chunk/s * 100 s) = 10 workers needed
+    assert advice.desired_workers == 10
+    assert advice.confidence == 1.0
+
+
+def test_advice_scales_down_under_starved_load_curve():
+    model = CapacityModel(target_drain_s=100.0)
+    for _ in range(8):
+        model.note_unit("w1", 1, 0.5)    # 2 chunks/s: plenty fast
+    advice = model.advise(3, 4, "starved")
+    assert advice.direction == "down" and advice.desired_workers == 1
+    # already at the floor: hold, never "scale to zero"
+    assert model.advise(3, 1, "starved").direction == "hold"
+
+
+def test_advice_holds_when_draining_or_capped():
+    model = CapacityModel(target_drain_s=10.0, max_workers=3)
+    model.note_unit("w1", 1, 10.0)
+    assert model.advise(500, 2, "draining").direction == "hold"
+    capped = model.advise(500, 3, "worker-bound")
+    assert capped.direction == "hold" and capped.desired_workers == 3
+
+
+def test_fleet_rate_and_eta():
+    model = CapacityModel()
+    model.note_unit("w1", 2, 1.0)        # 2 chunks/s
+    model.note_unit("w2", 1, 1.0)        # 1 chunk/s
+    assert model.worker_rate() == pytest.approx(1.5)
+    assert model.fleet_rate(4) == pytest.approx(6.0)
+    assert model.eta_s(12, 4) == pytest.approx(2.0)
+    assert model.eta_s(12, 0) is None    # no workers, no ETA
+
+
+# ---------------------------------------------------------------------------
+# coordinator: lease-wait histogram + EWMA feed off the complete wire
+# ---------------------------------------------------------------------------
+
+def test_complete_feeds_lease_wait_histogram_and_model(tmp_path):
+    fname = write_file(tmp_path / "a.fil", seed=5, pulse=False)
+    out = tmp_path / "fleet"
+    with FleetCoordinator(str(out), auto_sweep=False,
+                          capacity=True) as coordinator:
+        coordinator.add_survey([fname], **CONFIG)
+        fingerprint = \
+            coordinator.progress_doc()["files"][0]["fingerprint"]
+        w = coordinator.register({})["worker"]
+        lease = coordinator.lease({"worker": w,
+                                   "max_units": 1})["leases"][0]
+        store = CandidateStore(str(out), fingerprint)
+        for c in lease["chunks"]:
+            store.mark_done(c)
+        before = histogram_count("putpu_lease_wait_seconds")
+        resp = coordinator.complete({
+            "worker": w, "lease": lease["lease"], "unit": lease["unit"],
+            "error": None, "unit_wall_s": 0.01})
+        assert resp["unit_done"] is True
+        assert histogram_count("putpu_lease_wait_seconds") == before + 1
+        # the same report fed the EWMA throughput model
+        assert coordinator.capacity_model.observations() == 1
+        # absent-field back-compat: an old worker's complete (no
+        # unit_wall_s) neither observes the histogram nor crashes
+        lease2 = coordinator.lease({"worker": w,
+                                    "max_units": 1})["leases"][0]
+        for c in lease2["chunks"]:
+            store.mark_done(c)
+        coordinator.complete({"worker": w, "lease": lease2["lease"],
+                              "unit": lease2["unit"], "error": None})
+        assert histogram_count("putpu_lease_wait_seconds") == before + 1
+        assert coordinator.capacity_model.observations() == 1
+
+
+# ---------------------------------------------------------------------------
+# worker: idle-poll backoff
+# ---------------------------------------------------------------------------
+
+def test_idle_wait_backoff_grows_capped_and_accounts_idle():
+    w = FleetWorker.__new__(FleetWorker)  # no coordinator needed
+    w.poll_s = 0.01
+    w.idle_backoff_cap_s = 0.04
+    w._idle_streak = 0
+    w._drain = threading.Event()
+    w.util = UtilizationAccountant()
+    walls = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        assert w._idle_wait() is False
+        walls.append(time.monotonic() - t0)
+    # doubling until the cap: the later waits sit near cap + jitter,
+    # far above the first poll
+    assert walls[0] < 0.035
+    assert all(0.03 <= x <= 0.2 for x in walls[3:])
+    assert w._idle_streak == 5
+    assert w.util.idle_s == pytest.approx(sum(walls), rel=0.2)
+    assert w.util.busy_fraction() == 0.0  # all idle, no busy wall
+    # a drain mid-wait returns True immediately
+    w._drain.set()
+    assert w._idle_wait() is True
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: /fleet/capacity + report + byte-inertness
+# ---------------------------------------------------------------------------
+
+def _fleet_run(outdir, fnames, *, capacity, health=None, workers=2):
+    coordinator = FleetCoordinator(str(outdir), lease_ttl_s=60.0,
+                                   chunks_per_unit=1,
+                                   probe_interval_s=0.2,
+                                   capacity=capacity, health=health)
+    server = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        coordinator.add_survey(fnames, **CONFIG)
+        fleet = [FleetWorker(url, http_port=None)
+                 for _ in range(workers)]
+        threads = [threading.Thread(target=w.run,
+                                    kwargs={"max_idle_s": 60.0})
+                   for w in fleet]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        coordinator.sweep()
+        with urllib.request.urlopen(url + "/fleet/capacity",
+                                    timeout=10.0) as resp:
+            doc = json.loads(resp.read().decode())
+        progress = coordinator.progress_doc()
+        summary = coordinator.summary()
+    finally:
+        server.close()
+        coordinator.close()
+    return doc, progress, summary
+
+
+@pytest.mark.slow
+def test_fleet_capacity_endpoint_report_and_byte_inertness(tmp_path):
+    fname = write_file(tmp_path / "a.fil", seed=7, pulse=True)
+    ref_out = tmp_path / "ref"
+    search_by_chunks(fname, output_dir=str(ref_out), make_plots=False,
+                     progress=False, **CONFIG)
+    idle_before = obs_metrics.counter(
+        "putpu_fleet_idle_polls_total").value
+
+    off_doc, off_prog, off_sum = _fleet_run(
+        tmp_path / "off", [fname], capacity=False)
+    on_doc, on_prog, on_sum = _fleet_run(
+        tmp_path / "on", [fname], capacity=True, health=HealthEngine())
+
+    # byte-inertness: armed == off == single-process reference
+    ref = snapshot_dir(ref_out)
+    assert snapshot_dir(tmp_path / "off") == ref
+    assert snapshot_dir(tmp_path / "on") == ref
+
+    # capacity-off serves an explicit refusal, not a guessed doc
+    assert off_doc["enabled"] is False and "capacity" in off_doc["reason"]
+    assert "capacity" not in off_sum
+
+    # the armed document is evidenced end-to-end: detector state,
+    # per-worker throughput, advice — and rides the summary
+    assert on_doc["enabled"] is True
+    assert on_doc["state"] in SaturationDetector.STATES
+    assert on_doc["throughput"]["observations"] >= 2
+    assert on_doc["advice"]["direction"] in ("up", "down", "hold")
+    assert on_sum["capacity"]["enabled"] is True
+
+    # the /progress ETA seam exists in both arms (the EWMA model is
+    # always maintained; the capacity knob gates advice, not ETAs)
+    assert "eta_s" in off_prog and "eta_s" in on_prog
+
+    # worker utilization gauges rode the complete wire
+    fracs = [m for m in obs_metrics.REGISTRY.snapshot()
+             if m.get("name") == "putpu_worker_busy_fraction"]
+    assert fracs and all((m.get("labels") or {}).get("worker")
+                         for m in fracs)
+    # at least one worker idle-polled (two workers, two units: the
+    # loser of the last lease race polls an empty queue)
+    assert obs_metrics.counter("putpu_fleet_idle_polls_total").value \
+        >= idle_before
+
+    # report: armed -> a populated "Capacity & scaling" section
+    md = render_markdown(build_report(
+        meta={"root": "test"}, fleet=on_sum,
+        capacity=on_sum["capacity"]))
+    assert "## Capacity & scaling" in md
+    assert "Saturation state" in md
+    # absence is stated, never silently dropped
+    md_off = render_markdown(build_report(meta={"root": "test"},
+                                          fleet=off_sum))
+    assert "Capacity observability was off" in md_off

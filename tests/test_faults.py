@@ -570,14 +570,15 @@ def test_chaos_drill_full_matrix():
     tools/chaos_drill.py passes its recoverable/unrecoverable
     contract.
 
-    The counts assert the REAL current matrix (the 9-vs-7 drift this
-    test carried since the oom/periodicity classes landed is fixed —
-    ISSUE 15 satellite), extended with the coordinator-crash/partition
-    classes: recoverable = 7 fault-plan classes (transient dispatch/
-    hang/persist/read, sanitizable NaN, dead channels, transient OOM)
-    + period_accumulation + torn_ledger + killed_coordinator +
-    partitioned_worker + torn_journal = 12; contained = oom_floor +
-    hard_corrupt + truncated_read + dead_letter = 4.
+    The counts assert the REAL current matrix (this test drifted again
+    when the ISSUE 18/19 classes landed — re-pinned with the ISSUE 20
+    capacity classes): recoverable = 7 fault-plan classes (transient
+    dispatch/hang/persist/read, sanitizable NaN, dead channels,
+    transient OOM) + period_accumulation + torn_ledger +
+    killed_coordinator + partitioned_worker + torn_journal +
+    dead_subscriber + disconnected_feed + starved_fleet +
+    saturated_fleet = 16; contained = oom_floor + hard_corrupt +
+    truncated_read + dead_letter + lossy_feed + overrun_feed = 6.
     """
     import importlib.util
 
@@ -589,11 +590,11 @@ def test_chaos_drill_full_matrix():
     spec.loader.exec_module(drill)
     result = drill.run_drill(log=lambda *_: None)
     assert result["all_ok"], result["classes"]
-    assert result["n_classes"] == 16
-    assert result["recovered_identical"] == 12
-    assert result["contained"] == 4
+    assert result["n_classes"] == 22
+    assert result["recovered_identical"] == 16
+    assert result["contained"] == 6
     for name in ("killed_coordinator", "partitioned_worker",
-                 "torn_journal"):
+                 "torn_journal", "starved_fleet", "saturated_fleet"):
         assert result["classes"][name]["ok"], result["classes"][name]
 
 

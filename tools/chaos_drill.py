@@ -42,7 +42,14 @@ asserts the contracts ``docs/robustness.md`` documents:
   the socket reader never blocks, oldest chunks shed as
   ``shed_overrun``, sustained overrun reaches CRITICAL) — each class
   ends with the quarantine manifest mirroring the ingest ledger's
-  journal exactly and **zero unaccounted samples**.
+  journal exactly and **zero unaccounted samples**;
+* the **capacity advice engine** (ISSUE 20) reads load in both
+  directions: ``starved_fleet`` (more worker capacity than work —
+  the ``/fleet/capacity`` advice scales **down**) and
+  ``saturated_fleet`` (backlog growing under busy workers — advice
+  scales **up**, the ``fleet_saturated`` condition flashes DEGRADED
+  and decays back to OK at drain), both with survey outputs
+  byte-identical to the capacity-off baseline.
 
 Wired as ``bench_suite.py`` config 9 so the drill result lands next to
 the perf-gate artifacts; the same matrix runs as a ``slow``+``chaos``
@@ -397,6 +404,18 @@ def run_drill(quick=False, log=print, workdir=None, keep=False):
                      ("disconnected_feed", run_disconnected_feed_class),
                      ("overrun_feed", run_overrun_feed_class)):
         log(f"chaos drill: class {name}")
+        classes[name] = fn(base_dir, path, baseline, fingerprint, log)
+        log(f"chaos drill: class {name}: "
+            f"{'PASS' if classes[name]['ok'] else 'FAIL ' + str(classes[name])}")
+
+    # fleet capacity observability (ISSUE 20): the scaling-advice
+    # engine must read synthetic load in BOTH directions — starved
+    # scales down, saturated scales up with fleet_saturated flashing
+    # DEGRADED then decaying — and capacity-armed runs stay
+    # byte-identical (observability, never policy)
+    for name, fn in (("starved_fleet", run_starved_fleet_class),
+                     ("saturated_fleet", run_saturated_fleet_class)):
+        log(f"chaos drill: class {name} (recoverable)")
         classes[name] = fn(base_dir, path, baseline, fingerprint, log)
         log(f"chaos drill: class {name}: "
             f"{'PASS' if classes[name]['ok'] else 'FAIL ' + str(classes[name])}")
@@ -942,6 +961,166 @@ def run_overrun_feed_class(base_dir, path, baseline, fingerprint,
                  and sess["feed_wall_s"] < 10.0      # reader never wedged
                  and not audit_issues and rec["health_ok"])
     return rec
+
+
+# ---------------------------------------------------------------------------
+# fleet capacity observability chaos classes (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _get_capacity_doc(port):
+    """``GET /fleet/capacity`` over real HTTP — the drill checks the
+    served document, not the in-process object."""
+    from urllib.request import urlopen
+
+    with urlopen(f"http://127.0.0.1:{port}/fleet/capacity",
+                 timeout=10.0) as resp:
+        return json.loads(resp.read().decode())
+
+
+def run_starved_fleet_class(base_dir, path, baseline, fingerprint,
+                            log=print):
+    """**starved_fleet**: a capacity-armed fleet with far more worker
+    capacity than work.  A worker whose clocks say it spent ~300s
+    polling for every few seconds of searching (the injected fault:
+    idleness) reports a tiny busy fraction; with the queue drained the
+    detector must classify ``starved`` and the advice at
+    ``/fleet/capacity`` must point **down** — while the survey outputs
+    stay byte-identical to the capacity-off baseline (capacity is
+    observability, never policy)."""
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.obs.capacity import SaturationDetector
+    from pulsarutils_tpu.obs.server import start_obs_server
+
+    outdir = os.path.join(base_dir, "starved_fleet")
+    t0 = time.time()
+    coordinator = FleetCoordinator(outdir, lease_ttl_s=60.0,
+                                   chunks_per_unit=1, auto_sweep=False,
+                                   capacity=True)
+    # drill-scale hysteresis (one sweep confirms/decays) — the same
+    # time-compression every fleet class applies to lease TTLs
+    coordinator.saturation = SaturationDetector(confirm=1, decay=1)
+    server = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        coordinator.add_survey([path], **_fleet_config())
+        worker = FleetWorker(url, http_port=None)
+        # the starvation injection: the worker's own idle clock says it
+        # waited ~300s for leases around its one real unit
+        worker.util.note_idle(300.0)
+        _drain_after_first(worker)
+        worker.run()
+        # park the remaining units on a ghost worker: queue depth 0
+        # with leases in flight is the starved fleet's steady state
+        ghost = coordinator.register({})["worker"]
+        parked = coordinator.lease({"worker": ghost,
+                                    "max_units": 16})["leases"]
+        coordinator.sweep()
+        doc = _get_capacity_doc(server.port)
+        advice = doc.get("advice") or {}
+        # hand the parked units back and finish the survey for real
+        coordinator.release({"worker": ghost,
+                             "leases": [l["lease"] for l in parked],
+                             "reason": "drill"})
+        finisher = FleetWorker(url, http_port=None)
+        finisher.run(max_idle_s=60.0)
+        done = coordinator.survey_done
+    finally:
+        server.close()
+        coordinator.close()
+    fresh = snapshot_outputs(outdir, fingerprint)
+    diffs = diff_outputs(baseline, fresh)
+    return {"recoverable": True, "fired": 1,
+            "state": doc.get("state"),
+            "utilization": doc.get("utilization"),
+            "advice": advice, "survey_done": done,
+            "byte_identical": not diffs, "diffs": diffs,
+            "wall_s": round(time.time() - t0, 2),
+            "ok": (done and not diffs
+                   and doc.get("enabled") is True
+                   and doc.get("state") == "starved"
+                   and advice.get("direction") == "down"
+                   and advice.get("desired_workers", 99)
+                   < doc.get("workers_alive", 0))}
+
+
+def run_saturated_fleet_class(base_dir, path, baseline, fingerprint,
+                              log=print):
+    """**saturated_fleet**: the backlog grows while the only worker is
+    flat-out busy (a second survey lands mid-run).  The detector must
+    classify ``worker-bound``, the advice must point **up**, the
+    ``fleet_saturated`` health condition must flash DEGRADED — and
+    decay back to OK once the fleet drains, with the first survey's
+    outputs byte-identical to baseline."""
+    from pulsarutils_tpu.fleet.coordinator import FleetCoordinator
+    from pulsarutils_tpu.fleet.worker import FleetWorker
+    from pulsarutils_tpu.obs.capacity import SaturationDetector
+    from pulsarutils_tpu.obs.health import HealthEngine
+    from pulsarutils_tpu.obs.server import start_obs_server
+    from pulsarutils_tpu.pipeline.spectral_stats import get_bad_chans
+
+    outdir = os.path.join(base_dir, "saturated_fleet")
+    t0 = time.time()
+    path2 = os.path.join(base_dir, "survey2.fil")
+    if not os.path.exists(path2):
+        make_survey_file(path2)
+    get_bad_chans(path2)
+    health = HealthEngine()
+    coordinator = FleetCoordinator(outdir, lease_ttl_s=60.0,
+                                   chunks_per_unit=1, auto_sweep=False,
+                                   capacity=True, health=health)
+    coordinator.saturation = SaturationDetector(confirm=1, decay=1)
+    server = start_obs_server(0, fleet=coordinator)
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        coordinator.add_survey([path], **_fleet_config())
+        # one busy worker seeds the throughput model + a high busy
+        # fraction, then drains (still registered, still alive)
+        worker = FleetWorker(url, http_port=None)
+        _drain_after_first(worker)
+        worker.run()
+        # a bystander worker keeps the fleet from reading as draining
+        coordinator.register({})
+        coordinator.sweep()            # depth sample 1: steady backlog
+        coordinator.add_survey([path2], **_fleet_config())
+        coordinator.sweep()            # depth sample 2: backlog GREW
+        doc = _get_capacity_doc(server.port)
+        advice = doc.get("advice") or {}
+        degraded_seen = health.verdict != "OK"
+        # drain it for real: a fresh worker finishes both surveys
+        finisher = FleetWorker(url, http_port=None)
+        finisher.run(max_idle_s=60.0)
+        done = coordinator.survey_done
+        coordinator.sweep()            # draining -> condition decays
+        final_state = coordinator.saturation.state
+        final_verdict = health.verdict
+    finally:
+        server.close()
+        coordinator.close()
+    fresh = snapshot_outputs(outdir, fingerprint)
+    # survey2's candidates are real output, not drift: byte-identity is
+    # pinned on the FIRST survey's artifacts (its own ledger + npz)
+    fresh["cands"] = {n: v for n, v in fresh["cands"].items()
+                     if not n.startswith("survey2")}
+    diffs = diff_outputs(baseline, fresh)
+    return {"recoverable": True, "fired": 1,
+            "state": doc.get("state"),
+            "advice": advice, "degraded_seen": degraded_seen,
+            "final_state": final_state,
+            "final_verdict": final_verdict,
+            "survey_done": done,
+            "byte_identical": not diffs, "diffs": diffs,
+            "wall_s": round(time.time() - t0, 2),
+            "ok": (done and not diffs
+                   and doc.get("enabled") is True
+                   and doc.get("state") == "worker-bound"
+                   and advice.get("direction") == "up"
+                   and advice.get("desired_workers", 0)
+                   > doc.get("workers_alive", 99)
+                   and degraded_seen
+                   and final_state == "draining"
+                   and final_verdict == "OK")}
 
 
 # ---------------------------------------------------------------------------

@@ -101,11 +101,15 @@ from pulsarutils_tpu.obs import gate  # noqa: E402
 #: ring-buffer assembler diverges by a byte from the disk search in
 #: any per-chunk table or the hit list, any packet arrives damaged,
 #: or the ingest ledger ends with gap-filled, journaled, or
-#: unaccounted samples; all sixteen run in tier-1-scale time)
+#: unaccounted samples; 24: the capacity-observability A/B — its value
+#: drops to 0.0 when arming utilization/saturation/scaling-advice
+#: moves a candidate/ledger byte, the armed ``/fleet/capacity``
+#: document is missing/disabled/evidence-free, or the advice scales a
+#: drained fleet up; all seventeen run in tier-1-scale time)
 DEFAULT_BASELINE_FMT = os.path.join(REPO, "BENCH_GATE_{backend}.jsonl")
 DEFAULT_BASELINE = DEFAULT_BASELINE_FMT.format(backend="cpu")
 DEFAULT_CONFIGS = (1, 7, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21,
-                   22, 23)
+                   22, 23, 24)
 
 #: the committed tune-cache artifact the gate version-checks (the
 #: snapshot-schema rule of PR 5, applied to tuner measurements: a
@@ -182,11 +186,17 @@ DEFAULT_TUNE_ARTIFACT = os.path.join(REPO, "TUNE_cpu.json")
 #: forced 0.0 (per-chunk table byte divergence, differing hit lists,
 #: damaged packets, or any gap-filled/journaled/unaccounted sample in
 #: the ingest ledger), so the wall-clock bound applies.
+#: Config 24 (ISSUE 20) is the capacity-observability off/on wall
+#: quotient — two 2-worker fleet runs interleaving on one CPU core,
+#: the config-18 shape with the capacity layer instead; the gated
+#: signal is the forced 0.0 (byte divergence, a missing/disabled/
+#: evidence-free /fleet/capacity document, or scale-up advice on a
+#: drained fleet), so the wall-clock bound applies.
 #: Config 10 stays TIGHT: canary recall is deterministic, not jittery.
 DEFAULT_PER_CONFIG_TOL = {1: 0.75, 7: 1.2, 10: 0.08, 12: 0.75, 13: 0.75,
                           14: 0.75, 15: 0.75, 16: 0.75, 17: 0.75,
                           18: 0.75, 19: 0.75, 20: 0.75, 21: 0.75,
-                          22: 0.75, 23: 0.75}
+                          22: 0.75, 23: 0.75, 24: 0.75}
 
 
 def run_suite(configs, preset, out_path):
